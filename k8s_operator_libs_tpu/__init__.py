@@ -1,0 +1,33 @@
+"""k8s_operator_libs_tpu — TPU-fleet orchestration library.
+
+A re-design of NVIDIA/k8s-operator-libs (reference: /root/reference, a pure-Go
+Kubernetes operator utility library) for TPU fleets.  The reference provides
+
+  (a) a node-by-node driver-upgrade state machine for containerized
+      GPU/NIC drivers running as DaemonSets (``pkg/upgrade/``), and
+  (b) a CRD apply/delete helper (``pkg/crdutil/``).
+
+This package reproduces both capability sets and extends them TPU-first:
+
+  * the unavailability domain of the upgrade throttle is an ICI-connected
+    **TPU slice** (draining one host of a multi-host slice kills the whole
+    slice's SPMD workload), not a single node — see
+    :mod:`k8s_operator_libs_tpu.tpu.topology`;
+  * "drain" cooperates with JAX workloads via a checkpoint-on-drain
+    annotation handshake (orbax save before eviction) — see
+    :mod:`k8s_operator_libs_tpu.tpu.drain_handshake` — the inverse of the
+    reference's safe-driver-load handshake
+    (``pkg/upgrade/safe_driver_load_manager.go``).
+
+Layer map (mirrors SURVEY.md §1):
+
+  L4  ClusterUpgradeStateManager      upgrade/upgrade_state.py
+  L3  in-place / requestor modes      upgrade/upgrade_inplace.py, upgrade_requestor.py
+  L2  node-op managers                upgrade/{cordon,drain,pod,validation,...}_manager.py
+  L1  client plumbing                 cluster/ (in-memory apiserver + informer cache)
+  L0  API types                       api/upgrade_spec.py
+  side: crdutil/                      CRD lifecycle helper
+  side: tpu/                          slice topology, checkpoint-drain, demo workload
+"""
+
+__version__ = "0.1.0"

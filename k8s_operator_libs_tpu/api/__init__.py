@@ -1,0 +1,21 @@
+"""API types — the CRD-schema fragment (reference: api/upgrade/v1alpha1)."""
+
+from .intstr import IntOrString
+from .upgrade_spec import (
+    DrainSpec,
+    PodDeletionSpec,
+    PreDrainCheckpointSpec,
+    UpgradePolicySpec,
+    ValidationError,
+    WaitForCompletionSpec,
+)
+
+__all__ = [
+    "IntOrString",
+    "DrainSpec",
+    "PodDeletionSpec",
+    "PreDrainCheckpointSpec",
+    "UpgradePolicySpec",
+    "ValidationError",
+    "WaitForCompletionSpec",
+]

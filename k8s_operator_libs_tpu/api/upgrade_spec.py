@@ -1,0 +1,261 @@
+"""Upgrade-policy API types (the CRD-schema fragment consumers embed).
+
+Reference parity: ``api/upgrade/v1alpha1/upgrade_spec.go:27-110`` —
+``DriverUpgradePolicySpec`` with sub-specs ``PodDeletionSpec``,
+``WaitForCompletionSpec``, ``DrainSpec``, kubebuilder defaults
+(maxParallelUpgrades=1, maxUnavailable="25%", timeouts 300 s) and
+validation (Minimum:=0 markers).
+
+TPU-native extension: :class:`UpgradePolicySpec.slice_aware` plus
+:class:`PreDrainCheckpointSpec` — the unavailability throttle may count
+**TPU slices** (atomic ICI domains) instead of raw nodes, and the drain can
+be gated on a checkpoint-saved handshake from the JAX workload.
+
+Python mapping notes: Go pointer-typed optional sub-specs become
+``Optional`` dataclass fields; JSON (de)serialization uses the same
+camelCase keys as the reference so existing policy YAML carries over.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Union
+
+from .intstr import IntOrString
+
+
+class ValidationError(ValueError):
+    """Raised when a policy violates the schema's validation markers."""
+
+
+def _require_non_negative(name: str, value: int) -> None:
+    if value < 0:
+        raise ValidationError(f"{name} must be >= 0, got {value}")
+
+
+@dataclass
+class WaitForCompletionSpec:
+    """Wait for consumer jobs to finish before upgrading a node.
+
+    Reference: upgrade_spec.go:52-66.
+    """
+
+    #: Label selector (string form, e.g. ``"app=training,job!=dev"``) for
+    #: pods to wait on.  Empty means the phase is skipped.
+    pod_selector: str = ""
+    #: Seconds to wait before giving up; 0 means infinite (default 0).
+    timeout_second: int = 0
+
+    def validate(self) -> None:
+        _require_non_negative("waitForCompletion.timeoutSeconds", self.timeout_second)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "podSelector": self.pod_selector,
+            "timeoutSeconds": self.timeout_second,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "WaitForCompletionSpec":
+        return cls(
+            pod_selector=d.get("podSelector", ""),
+            timeout_second=d.get("timeoutSeconds", 0),
+        )
+
+
+@dataclass
+class PodDeletionSpec:
+    """Deletion of pods using special resources during upgrade.
+
+    Reference: upgrade_spec.go:68-86.
+    """
+
+    force: bool = False
+    #: Seconds before giving up on pod termination; 0 = infinite (default 300).
+    timeout_second: int = 300
+    #: Proceed even if pods use emptyDir (local data lost on delete).
+    delete_empty_dir: bool = False
+
+    def validate(self) -> None:
+        _require_non_negative("podDeletion.timeoutSeconds", self.timeout_second)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "force": self.force,
+            "timeoutSeconds": self.timeout_second,
+            "deleteEmptyDir": self.delete_empty_dir,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PodDeletionSpec":
+        return cls(
+            force=d.get("force", False),
+            timeout_second=d.get("timeoutSeconds", 300),
+            delete_empty_dir=d.get("deleteEmptyDir", False),
+        )
+
+
+@dataclass
+class DrainSpec:
+    """Node-drain configuration during upgrade.
+
+    Reference: upgrade_spec.go:88-110.
+    """
+
+    enable: bool = False
+    force: bool = False
+    #: Label selector filtering pods on the node that need draining;
+    #: empty selects all (DaemonSet pods are always ignored — the driver
+    #: itself is a DaemonSet pod; reference drain_manager.go:76-96).
+    pod_selector: str = ""
+    #: Seconds before giving up the drain; 0 = infinite (default 300).
+    timeout_second: int = 300
+    delete_empty_dir: bool = False
+
+    def validate(self) -> None:
+        _require_non_negative("drain.timeoutSeconds", self.timeout_second)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "enable": self.enable,
+            "force": self.force,
+            "podSelector": self.pod_selector,
+            "timeoutSeconds": self.timeout_second,
+            "deleteEmptyDir": self.delete_empty_dir,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DrainSpec":
+        return cls(
+            enable=d.get("enable", False),
+            force=d.get("force", False),
+            pod_selector=d.get("podSelector", ""),
+            timeout_second=d.get("timeoutSeconds", 300),
+            delete_empty_dir=d.get("deleteEmptyDir", False),
+        )
+
+
+@dataclass
+class PreDrainCheckpointSpec:
+    """TPU-native: gate drain on a checkpoint-saved handshake.
+
+    Before evicting workload pods, the orchestrator sets the
+    ``<component>-pre-drain-checkpoint=requested`` node annotation; the JAX
+    launcher saves an orbax checkpoint and answers ``done``.  The drain
+    proceeds on ``done`` or after ``timeout_second``.  This is the inverse
+    of the reference's safe-driver-load handshake
+    (safe_driver_load_manager.go:51-71 + docs/automatic-ofed-upgrade.md:43-66).
+    """
+
+    enable: bool = False
+    #: Seconds to wait for the workload's "done" ack; 0 = infinite.
+    timeout_second: int = 300
+
+    def validate(self) -> None:
+        _require_non_negative(
+            "preDrainCheckpoint.timeoutSeconds", self.timeout_second
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"enable": self.enable, "timeoutSeconds": self.timeout_second}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PreDrainCheckpointSpec":
+        return cls(
+            enable=d.get("enable", False),
+            timeout_second=d.get("timeoutSeconds", 300),
+        )
+
+
+@dataclass
+class UpgradePolicySpec:
+    """Policy for automatic component upgrades across the fleet.
+
+    Reference: ``DriverUpgradePolicySpec`` (upgrade_spec.go:27-49) with
+    kubebuilder defaults reproduced here as dataclass defaults.
+    """
+
+    #: Global switch; if False every other option is ignored
+    #: (ApplyState guard — reference upgrade_state.go:175-182).
+    auto_upgrade: bool = False
+    #: How many nodes may upgrade in parallel; 0 = no limit (default 1).
+    max_parallel_upgrades: int = 1
+    #: Max number (or percentage, rounded up) of nodes that may be
+    #: unavailable during upgrade (default "25%").
+    max_unavailable: Optional[IntOrString] = field(
+        default_factory=lambda: IntOrString("25%")
+    )
+    pod_deletion: Optional[PodDeletionSpec] = None
+    wait_for_completion: Optional[WaitForCompletionSpec] = None
+    drain_spec: Optional[DrainSpec] = None
+    # ---- TPU-native fields ------------------------------------------------
+    #: Count unavailability in slice domains (atomic ICI groups) not nodes.
+    slice_aware: bool = False
+    pre_drain_checkpoint: Optional[PreDrainCheckpointSpec] = None
+
+    def __post_init__(self) -> None:
+        if isinstance(self.max_unavailable, (int, str)):
+            self.max_unavailable = IntOrString(self.max_unavailable)
+
+    def validate(self) -> None:
+        _require_non_negative("maxParallelUpgrades", self.max_parallel_upgrades)
+        for sub in (
+            self.pod_deletion,
+            self.wait_for_completion,
+            self.drain_spec,
+            self.pre_drain_checkpoint,
+        ):
+            if sub is not None:
+                sub.validate()
+        if self.max_unavailable is not None and not self.max_unavailable.is_percent:
+            _require_non_negative("maxUnavailable", self.max_unavailable.value)  # type: ignore[arg-type]
+
+    # -- JSON round-trip (camelCase keys match the reference CRD schema) ---
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "autoUpgrade": self.auto_upgrade,
+            "maxParallelUpgrades": self.max_parallel_upgrades,
+        }
+        if self.max_unavailable is not None:
+            out["maxUnavailable"] = self.max_unavailable.to_raw()
+        if self.pod_deletion is not None:
+            out["podDeletion"] = self.pod_deletion.to_dict()
+        if self.wait_for_completion is not None:
+            out["waitForCompletion"] = self.wait_for_completion.to_dict()
+        if self.drain_spec is not None:
+            out["drain"] = self.drain_spec.to_dict()
+        if self.slice_aware:
+            out["sliceAware"] = True
+        if self.pre_drain_checkpoint is not None:
+            out["preDrainCheckpoint"] = self.pre_drain_checkpoint.to_dict()
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "UpgradePolicySpec":
+        raw_mu: Union[int, str, None] = d.get("maxUnavailable", "25%")
+        return cls(
+            auto_upgrade=d.get("autoUpgrade", False),
+            max_parallel_upgrades=d.get("maxParallelUpgrades", 1),
+            max_unavailable=IntOrString.parse(raw_mu),
+            pod_deletion=(
+                PodDeletionSpec.from_dict(d["podDeletion"])
+                if d.get("podDeletion") is not None
+                else None
+            ),
+            wait_for_completion=(
+                WaitForCompletionSpec.from_dict(d["waitForCompletion"])
+                if d.get("waitForCompletion") is not None
+                else None
+            ),
+            drain_spec=(
+                DrainSpec.from_dict(d["drain"])
+                if d.get("drain") is not None
+                else None
+            ),
+            slice_aware=d.get("sliceAware", False),
+            pre_drain_checkpoint=(
+                PreDrainCheckpointSpec.from_dict(d["preDrainCheckpoint"])
+                if d.get("preDrainCheckpoint") is not None
+                else None
+            ),
+        )

@@ -1,0 +1,41 @@
+"""Client plumbing: in-memory apiserver, informer cache, selectors, retry.
+
+This is layer L1 of the stack (SURVEY.md §1) — the analog of
+controller-runtime client + client-go + envtest in the reference.
+"""
+
+from .cache import InformerCache
+from .errors import (
+    AlreadyExistsError,
+    ApiError,
+    BadRequestError,
+    ConflictError,
+    ExpiredError,
+    NotFoundError,
+    is_already_exists,
+    is_conflict,
+    is_not_found,
+)
+from .inmem import InMemoryCluster, WatchEvent, merge_patch
+from .retry import retry_on_conflict
+from .selectors import labels_to_selector, matches, parse_selector
+
+__all__ = [
+    "InformerCache",
+    "InMemoryCluster",
+    "WatchEvent",
+    "merge_patch",
+    "retry_on_conflict",
+    "parse_selector",
+    "matches",
+    "labels_to_selector",
+    "ApiError",
+    "ExpiredError",
+    "NotFoundError",
+    "ConflictError",
+    "AlreadyExistsError",
+    "BadRequestError",
+    "is_not_found",
+    "is_conflict",
+    "is_already_exists",
+]

@@ -1,0 +1,80 @@
+"""Eventually-consistent informer cache over the in-memory apiserver.
+
+The reference reads nodes through the controller-runtime **informer
+cache**, whose lag is why ``NodeUpgradeStateProvider`` polls up to 10 s
+after every write until the write becomes visible
+(node_upgrade_state_provider.go:100-117, 171-197).  To keep that
+contract real (and testable) rather than vacuous, this cache serves reads
+from a point-in-time snapshot that only refreshes when older than
+``lag_seconds`` — lag 0 reproduces an always-fresh cache.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .errors import NotFoundError
+from .inmem import InMemoryCluster, JsonObj, Key
+from .selectors import parse_selector
+
+
+class InformerCache:
+    """Read-path facade with configurable staleness."""
+
+    def __init__(self, cluster: InMemoryCluster, lag_seconds: float = 0.0) -> None:
+        self._cluster = cluster
+        self.lag_seconds = lag_seconds
+        self._lock = threading.Lock()
+        self._snapshot: Dict[Key, JsonObj] = {}
+        self._last_sync = float("-inf")
+        self.sync()
+
+    def sync(self) -> None:
+        """Force a full resync (informer list/watch refresh)."""
+        snap = self._cluster.snapshot()
+        with self._lock:
+            self._snapshot = snap
+            self._last_sync = time.monotonic()
+
+    def _maybe_sync(self) -> None:
+        with self._lock:
+            stale = time.monotonic() - self._last_sync >= self.lag_seconds
+        if stale:
+            self.sync()
+
+    def get(self, kind: str, name: str, namespace: str = "") -> JsonObj:
+        if self.lag_seconds <= 0:
+            # Always-fresh cache: serve straight from the store (per-object
+            # copy) instead of deep-copying the whole store per read.
+            try:
+                return self._cluster.get(kind, name, namespace)
+            except NotFoundError:
+                raise NotFoundError(f"{kind} {namespace}/{name} not in cache")
+        self._maybe_sync()
+        with self._lock:
+            obj = self._snapshot.get((kind, namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not in cache")
+            return copy.deepcopy(obj)
+
+    def list(
+        self, kind: str, namespace: Optional[str] = None, label_selector: str = ""
+    ) -> List[JsonObj]:
+        if self.lag_seconds <= 0:
+            return self._cluster.list(kind, namespace, label_selector)
+        self._maybe_sync()
+        match = parse_selector(label_selector)
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in sorted(self._snapshot.items()):
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                labels = (obj.get("metadata") or {}).get("labels") or {}
+                if match(labels):
+                    out.append(copy.deepcopy(obj))
+            return out

@@ -1,0 +1,256 @@
+"""In-memory kube-apiserver — the test/simulation substrate.
+
+The reference tests against **envtest** (a real kube-apiserver + etcd with
+no kubelet/scheduler — SURVEY.md §4); that substrate is not available
+here (no Go toolchain, no network), so this module provides the same
+contract in-process:
+
+* objects are plain JSON-style dicts (Nodes, Pods, DaemonSets,
+  ControllerRevisions, NodeMaintenances, CRDs, ...) stored by
+  (kind, namespace, name);
+* every write bumps ``metadata.resourceVersion``; ``update`` and
+  RV-carrying patches enforce optimistic concurrency with
+  :class:`~.errors.ConflictError`, which is what makes the requestor
+  mode's shared-requestor patch protocol
+  (reference upgrade_requestor.go:320-368) testable under concurrent
+  writers;
+* ``merge_patch`` implements RFC 7386 (null deletes a key) — the
+  mechanism behind the reference's annotation deletion patches
+  (node_upgrade_state_provider.go:147-151);
+* like envtest, there are **no controllers**: DaemonSet status, pod
+  phases etc. are hand-set by tests/simulations via ``update``;
+* a monotonically sequenced event journal supports informer-style watch
+  semantics (used by the :mod:`~.cache` informer cache and the
+  requestor-mode predicates).
+
+Thread-safe: all operations take an internal lock; returned objects are
+deep copies (mutating them never mutates the store — same contract as
+client-go's cache-copy discipline).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .errors import (
+    AlreadyExistsError,
+    BadRequestError,
+    ConflictError,
+    ExpiredError,
+    NotFoundError,
+)
+from .selectors import parse_selector
+
+JsonObj = Dict[str, Any]
+Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+
+def _key_of(obj: JsonObj) -> Key:
+    kind = obj.get("kind")
+    meta = obj.get("metadata") or {}
+    name = meta.get("name")
+    if not kind or not name:
+        raise BadRequestError("object needs kind and metadata.name")
+    return (kind, meta.get("namespace", ""), name)
+
+
+def merge_patch(target: JsonObj, patch: JsonObj) -> JsonObj:
+    """RFC 7386 JSON merge patch: dicts merge recursively, null deletes."""
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        elif isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = merge_patch(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+class WatchEvent:
+    """One journal entry: Added / Modified / Deleted with old+new objects."""
+
+    __slots__ = ("seq", "type", "old", "new")
+
+    def __init__(self, seq: int, type_: str, old: Optional[JsonObj], new: Optional[JsonObj]):
+        self.seq = seq
+        self.type = type_
+        self.old = old
+        self.new = new
+
+
+class InMemoryCluster:
+    """A stand-in kube-apiserver holding typed-but-schemaless JSON objects."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._store: Dict[Key, JsonObj] = {}
+        self._rv = 0
+        self._journal: List[WatchEvent] = []
+        self._journal_cap = 10000
+        self._journal_floor = 0  # highest seq evicted from the journal
+
+    # ------------------------------------------------------------------ util
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _record(self, type_: str, old: Optional[JsonObj], new: Optional[JsonObj]) -> None:
+        self._journal.append(WatchEvent(self._rv, type_, old, new))
+        if len(self._journal) > self._journal_cap:
+            evicted = len(self._journal) - self._journal_cap
+            self._journal_floor = self._journal[evicted - 1].seq
+            del self._journal[:evicted]
+
+    # ------------------------------------------------------------------ CRUD
+    def create(self, obj: JsonObj) -> JsonObj:
+        with self._lock:
+            key = _key_of(obj)
+            if key in self._store:
+                raise AlreadyExistsError(f"{key} already exists")
+            stored = copy.deepcopy(obj)
+            meta = stored.setdefault("metadata", {})
+            meta["resourceVersion"] = self._next_rv()
+            meta.setdefault("uid", str(uuid.uuid4()))
+            meta.setdefault("creationTimestamp", time.time())
+            self._store[key] = stored
+            self._record("Added", None, copy.deepcopy(stored))
+            return copy.deepcopy(stored)
+
+    def get(self, kind: str, name: str, namespace: str = "") -> JsonObj:
+        with self._lock:
+            obj = self._store.get((kind, namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: str = "",
+        field_filter: Optional[Callable[[JsonObj], bool]] = None,
+    ) -> List[JsonObj]:
+        match = parse_selector(label_selector)
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in sorted(self._store.items()):
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                labels = (obj.get("metadata") or {}).get("labels") or {}
+                if not match(labels):
+                    continue
+                if field_filter is not None and not field_filter(obj):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def update(self, obj: JsonObj) -> JsonObj:
+        """Full-object replace with optimistic concurrency on resourceVersion."""
+        with self._lock:
+            key = _key_of(obj)
+            current = self._store.get(key)
+            if current is None:
+                raise NotFoundError(f"{key} not found")
+            sent_rv = (obj.get("metadata") or {}).get("resourceVersion")
+            if sent_rv is not None and sent_rv != current["metadata"]["resourceVersion"]:
+                raise ConflictError(
+                    f"{key}: resourceVersion {sent_rv} != {current['metadata']['resourceVersion']}"
+                )
+            old = copy.deepcopy(current)
+            stored = copy.deepcopy(obj)
+            stored["metadata"]["uid"] = current["metadata"]["uid"]
+            stored["metadata"]["creationTimestamp"] = current["metadata"][
+                "creationTimestamp"
+            ]
+            stored["metadata"]["resourceVersion"] = self._next_rv()
+            self._store[key] = stored
+            self._record("Modified", old, copy.deepcopy(stored))
+            return copy.deepcopy(stored)
+
+    #: Status subresource writes share update semantics here (envtest-style
+    #: hand-set status — reference upgrade_suit_test.go:344-355, 416-428).
+    update_status = update
+
+    def patch(
+        self, kind: str, name: str, patch_body: JsonObj, namespace: str = ""
+    ) -> JsonObj:
+        """JSON merge patch (RFC 7386).  Strategic-merge is the same for the
+        map-typed fields (labels/annotations) this library patches.
+
+        If the patch carries ``metadata.resourceVersion`` the server enforces
+        it (optimistic lock) — this is how the reference's shared-requestor
+        patch protocol detects concurrent writers
+        (upgrade_requestor.go:344-357).
+        """
+        with self._lock:
+            key = (kind, namespace, name)
+            current = self._store.get(key)
+            if current is None:
+                raise NotFoundError(f"{key} not found")
+            sent_rv = (patch_body.get("metadata") or {}).get("resourceVersion")
+            if sent_rv is not None and sent_rv != current["metadata"]["resourceVersion"]:
+                raise ConflictError(
+                    f"{key}: patch resourceVersion {sent_rv} != "
+                    f"{current['metadata']['resourceVersion']}"
+                )
+            old = copy.deepcopy(current)
+            merged = merge_patch(current, patch_body)
+            # kind / name / namespace / uid are immutable, like a real apiserver
+            merged["kind"] = kind
+            merged["metadata"]["uid"] = current["metadata"]["uid"]
+            merged["metadata"]["name"] = name
+            if namespace:
+                merged["metadata"]["namespace"] = namespace
+            else:
+                merged["metadata"].pop("namespace", None)
+            merged["metadata"]["resourceVersion"] = self._next_rv()
+            self._store[key] = merged
+            self._record("Modified", old, copy.deepcopy(merged))
+            return copy.deepcopy(merged)
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        with self._lock:
+            key = (kind, namespace, name)
+            obj = self._store.pop(key, None)
+            if obj is None:
+                raise NotFoundError(f"{key} not found")
+            self._next_rv()  # deletions advance the version sequence too
+            self._record("Deleted", copy.deepcopy(obj), None)
+
+    # ------------------------------------------------------------- watch API
+    def journal_seq(self) -> int:
+        with self._lock:
+            return self._rv
+
+    def events_since(self, seq: int, kind: Optional[str] = None) -> List[WatchEvent]:
+        """Watch events after *seq*.  Raises :class:`ExpiredError` (the 410
+        Gone analog) when *seq* predates the journal's retained window, so a
+        slow watcher knows to relist instead of silently missing events."""
+        with self._lock:
+            if seq < self._journal_floor:
+                raise ExpiredError(
+                    f"watch seq {seq} older than journal floor {self._journal_floor}"
+                )
+            return [
+                ev
+                for ev in self._journal
+                if ev.seq > seq
+                and (kind is None or (ev.new or ev.old or {}).get("kind") == kind)
+            ]
+
+    # ----------------------------------------------------------- conveniences
+    def exists(self, kind: str, name: str, namespace: str = "") -> bool:
+        with self._lock:
+            return (kind, namespace, name) in self._store
+
+    def snapshot(self) -> Dict[Key, JsonObj]:
+        """Deep-copied point-in-time view of the whole store (informer sync)."""
+        with self._lock:
+            return copy.deepcopy(self._store)

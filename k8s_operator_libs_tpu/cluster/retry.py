@@ -1,0 +1,44 @@
+"""RetryOnConflict — optimistic-concurrency retry loop.
+
+Reference parity: ``retry.RetryOnConflict(retry.DefaultRetry, ...)`` used by
+crdutil's update path (crdutil.go:230-249) and the requestor-mode
+shared-requestor patch (upgrade_requestor.go:344-357).  client-go's
+DefaultRetry is 5 steps, 10 ms base, factor 1.0, jitter 0.1.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, TypeVar
+
+from .errors import ConflictError
+
+T = TypeVar("T")
+
+DEFAULT_RETRY_STEPS = 5
+DEFAULT_RETRY_BASE_SECONDS = 0.01
+DEFAULT_RETRY_JITTER = 0.1
+
+
+def retry_on_conflict(
+    fn: Callable[[], T],
+    steps: int = DEFAULT_RETRY_STEPS,
+    base_seconds: float = DEFAULT_RETRY_BASE_SECONDS,
+    jitter: float = DEFAULT_RETRY_JITTER,
+) -> T:
+    """Run *fn*, retrying up to *steps* times while it raises ConflictError.
+
+    The callable must re-read the object inside itself (get → mutate →
+    update), exactly like the Go closure contract.
+    """
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    for attempt in range(steps):
+        try:
+            return fn()
+        except ConflictError:
+            if attempt == steps - 1:
+                raise
+            time.sleep(base_seconds * (1.0 + jitter * random.random()))
+    raise AssertionError("unreachable")

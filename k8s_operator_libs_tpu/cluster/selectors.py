@@ -1,0 +1,100 @@
+"""Kubernetes label-selector string parsing and matching.
+
+The reference passes selector strings through to the API server
+(``labels.Parse`` semantics — used for DrainSpec.PodSelector,
+WaitForCompletionSpec.PodSelector, validation pod selectors).  We implement
+the equality-based and set-based grammar:
+
+    "a=b", "a==b", "a!=b", "a in (x,y)", "a notin (x,y)", "a" (exists),
+    "!a" (not exists), comma-joined conjunction.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Mapping
+
+_IN_RE = re.compile(r"^\s*([\w./-]+)\s+(in|notin)\s+\(([^)]*)\)\s*$")
+_EQ_RE = re.compile(r"^\s*([\w./-]+)\s*(==|=|!=)\s*([\w./-]*)\s*$")
+_EXISTS_RE = re.compile(r"^\s*(!?)\s*([\w./-]+)\s*$")
+
+Matcher = Callable[[Mapping[str, str]], bool]
+
+
+class SelectorParseError(ValueError):
+    pass
+
+
+def _split_requirements(selector: str) -> List[str]:
+    """Split on commas that are not inside an ``in (...)`` value set."""
+    parts: List[str] = []
+    depth = 0
+    cur = ""
+    for ch in selector:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur.strip():
+        parts.append(cur)
+    return parts
+
+
+def parse_selector(selector: str) -> Matcher:
+    """Compile a selector string into a predicate over a labels mapping.
+
+    An empty selector matches everything (k8s ``labels.Everything()``).
+    """
+    selector = (selector or "").strip()
+    if not selector:
+        return lambda labels: True
+
+    requirements: List[Matcher] = []
+    for req in _split_requirements(selector):
+        m = _IN_RE.match(req)
+        if m:
+            key, op, vals = m.group(1), m.group(2), m.group(3)
+            values = {v.strip() for v in vals.split(",") if v.strip()}
+            if op == "in":
+                requirements.append(
+                    lambda labels, k=key, vs=values: labels.get(k) in vs
+                )
+            else:
+                requirements.append(
+                    lambda labels, k=key, vs=values: k in labels
+                    and labels.get(k) not in vs
+                )
+            continue
+        m = _EQ_RE.match(req)
+        if m:
+            key, op, val = m.group(1), m.group(2), m.group(3)
+            if op in ("=", "=="):
+                requirements.append(lambda labels, k=key, v=val: labels.get(k) == v)
+            else:
+                requirements.append(lambda labels, k=key, v=val: labels.get(k) != v)
+            continue
+        m = _EXISTS_RE.match(req)
+        if m:
+            neg, key = m.group(1), m.group(2)
+            if neg:
+                requirements.append(lambda labels, k=key: k not in labels)
+            else:
+                requirements.append(lambda labels, k=key: k in labels)
+            continue
+        raise SelectorParseError(f"cannot parse selector requirement {req!r}")
+
+    return lambda labels: all(r(labels) for r in requirements)
+
+
+def matches(selector: str, labels: Mapping[str, str] | None) -> bool:
+    return parse_selector(selector)(labels or {})
+
+
+def labels_to_selector(labels: Dict[str, str]) -> str:
+    """Reference: labels.SelectorFromSet — exact-match conjunction."""
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))

@@ -1,0 +1,160 @@
+"""State, label and annotation vocabulary for the upgrade state machine.
+
+Reference parity: ``pkg/upgrade/consts.go:20-93`` — 13 node upgrade states and
+8 label/annotation key formats parameterized by the managed component name
+(the reference parameterizes by driver name, e.g.
+``nvidia.com/gpu-driver-upgrade-state``).  We use the ``tpu.google.com``
+domain and parameterize by *component* (e.g. ``tpu-runtime``, ``libtpu``).
+
+Two TPU-native additions on top of the reference vocabulary:
+
+* ``PRE_DRAIN_CHECKPOINT_ANNOTATION_KEY_FMT`` — the checkpoint-on-drain
+  handshake key (inverse of the reference's safe-driver-load handshake,
+  ``pkg/upgrade/safe_driver_load_manager.go:51-71``).
+* ``SLICE_ID_LABEL_KEYS`` — node labels from which the slice/failure-domain
+  identity is derived for the slice-aware throttle (SURVEY.md §7 step 4).
+"""
+
+from __future__ import annotations
+
+# --------------------------------------------------------------------------
+# Node upgrade states — reference: pkg/upgrade/consts.go:48-83.
+# Processed by ApplyState in the order documented in SURVEY.md §2.
+# --------------------------------------------------------------------------
+
+#: Node has no state label yet (never seen by the state machine).
+UPGRADE_STATE_UNKNOWN = ""
+#: Driver/runtime pod on the node is in sync with the latest DaemonSet revision.
+UPGRADE_STATE_DONE = "upgrade-done"
+#: Node needs an upgrade (pod out of sync, or upgrade requested explicitly).
+UPGRADE_STATE_UPGRADE_REQUIRED = "upgrade-required"
+#: Node was granted an upgrade slot; it must be cordoned next.
+UPGRADE_STATE_CORDON_REQUIRED = "cordon-required"
+#: Node is cordoned; waiting for user jobs to finish (WaitForCompletionSpec).
+UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED = "wait-for-jobs-required"
+#: Workload pods matching the consumer's filter must be deleted.
+UPGRADE_STATE_POD_DELETION_REQUIRED = "pod-deletion-required"
+#: Node must be drained (full kubectl-drain semantics).
+UPGRADE_STATE_DRAIN_REQUIRED = "drain-required"
+#: Driver/runtime pod must be restarted to pick up the new revision.
+UPGRADE_STATE_POD_RESTART_REQUIRED = "pod-restart-required"
+#: Post-upgrade validation pods must become Running+Ready.
+UPGRADE_STATE_VALIDATION_REQUIRED = "validation-required"
+#: Node must be uncordoned to finish the upgrade.
+UPGRADE_STATE_UNCORDON_REQUIRED = "uncordon-required"
+#: Upgrade failed (drain error, restart storm, validation timeout).
+UPGRADE_STATE_FAILED = "upgrade-failed"
+#: (requestor mode) NodeMaintenance CR created; external operator is working.
+UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED = "node-maintenance-required"
+#: (requestor mode) declared but not yet wired in the reference either —
+#: requestor transitions straight node-maintenance-required → pod-restart-required
+#: (reference TODO at upgrade_state.go:249-250; consts.go:70).
+UPGRADE_STATE_POST_MAINTENANCE_REQUIRED = "post-maintenance-required"
+
+#: Every known state value (including the empty "unknown" state).
+ALL_STATES = (
+    UPGRADE_STATE_UNKNOWN,
+    UPGRADE_STATE_DONE,
+    UPGRADE_STATE_UPGRADE_REQUIRED,
+    UPGRADE_STATE_CORDON_REQUIRED,
+    UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED,
+    UPGRADE_STATE_POD_DELETION_REQUIRED,
+    UPGRADE_STATE_DRAIN_REQUIRED,
+    UPGRADE_STATE_POD_RESTART_REQUIRED,
+    UPGRADE_STATE_VALIDATION_REQUIRED,
+    UPGRADE_STATE_UNCORDON_REQUIRED,
+    UPGRADE_STATE_FAILED,
+    UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED,
+    UPGRADE_STATE_POST_MAINTENANCE_REQUIRED,
+)
+
+#: States that count as "upgrade in progress" for the throttle census.
+#: Reference: pkg/upgrade/common_manager.go (GetUpgradesInProgress counts nodes
+#: in any active state bucket).
+ACTIVE_STATES = (
+    UPGRADE_STATE_CORDON_REQUIRED,
+    UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED,
+    UPGRADE_STATE_POD_DELETION_REQUIRED,
+    UPGRADE_STATE_DRAIN_REQUIRED,
+    UPGRADE_STATE_POD_RESTART_REQUIRED,
+    UPGRADE_STATE_VALIDATION_REQUIRED,
+    UPGRADE_STATE_UNCORDON_REQUIRED,
+    UPGRADE_STATE_FAILED,
+    UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED,
+    UPGRADE_STATE_POST_MAINTENANCE_REQUIRED,
+)
+
+# --------------------------------------------------------------------------
+# Label / annotation key formats — reference: pkg/upgrade/consts.go:20-47.
+# All are parameterized by the managed component name via util.key builders.
+# --------------------------------------------------------------------------
+
+DOMAIN = "tpu.google.com"
+
+#: Node label carrying the state-machine state.
+#: Reference fmt: "nvidia.com/%s-driver-upgrade-state" (consts.go:21).
+UPGRADE_STATE_LABEL_KEY_FMT = DOMAIN + "/%s-upgrade-state"
+
+#: Node label that opts a node out of managed upgrades entirely.
+UPGRADE_SKIP_NODE_LABEL_KEY_FMT = DOMAIN + "/%s-upgrade.skip"
+
+#: Node annotation set by the driver pod's init container to request the
+#: safe-load handshake (block runtime start until node is quiesced).
+#: Reference: "nvidia.com/%s-driver-upgrade.driver-wait-for-safe-load".
+UPGRADE_WAIT_FOR_SAFE_LOAD_ANNOTATION_KEY_FMT = (
+    DOMAIN + "/%s-upgrade.wait-for-safe-load"
+)
+
+#: Node annotation through which a user forces an upgrade cycle.
+UPGRADE_REQUESTED_ANNOTATION_KEY_FMT = DOMAIN + "/%s-upgrade.requested"
+
+#: Node annotation recording that the node was already unschedulable before
+#: the upgrade began — such nodes skip the final uncordon
+#: (reference: common_manager.go:250-264, 540-565).
+UPGRADE_INITIAL_STATE_ANNOTATION_KEY_FMT = (
+    DOMAIN + "/%s-upgrade.node-initial-state.unschedulable"
+)
+
+#: Node annotation holding the wall-clock start of the wait-for-completion
+#: phase, for timeout tracking (reference: pod_manager.go:331-368).
+UPGRADE_WAIT_FOR_POD_COMPLETION_START_TIME_ANNOTATION_KEY_FMT = (
+    DOMAIN + "/%s-upgrade.wait-for-pod-completion-start-time"
+)
+
+#: Node annotation holding the wall-clock start of the validation phase,
+#: for timeout tracking (reference: validation_manager.go:139-175).
+UPGRADE_VALIDATION_START_TIME_ANNOTATION_KEY_FMT = (
+    DOMAIN + "/%s-upgrade.validation-start-time"
+)
+
+#: Node annotation marking that this node's upgrade is being handled in
+#: requestor (maintenance-operator) mode (reference: util.go:134-138).
+UPGRADE_REQUESTOR_MODE_ANNOTATION_KEY_FMT = DOMAIN + "/%s-upgrade.requestor-mode"
+
+# ---- TPU-native additions -------------------------------------------------
+
+#: Node annotation used for the checkpoint-on-drain handshake.  The
+#: orchestrator sets it to "requested" before draining; the JAX launcher on
+#: the node saves an orbax checkpoint and sets it to "done"; the drain
+#: manager proceeds once it reads "done" (or after a timeout).
+PRE_DRAIN_CHECKPOINT_ANNOTATION_KEY_FMT = DOMAIN + "/%s-pre-drain-checkpoint"
+
+#: Values of the pre-drain-checkpoint annotation.
+PRE_DRAIN_CHECKPOINT_REQUESTED = "requested"
+PRE_DRAIN_CHECKPOINT_DONE = "done"
+
+#: Node labels (checked in order) from which the slice identity is derived.
+#: Hosts sharing a value form one atomic unavailability domain.
+SLICE_ID_LABEL_KEYS = (
+    DOMAIN + "/slice-id",
+    "cloud.google.com/gke-tpu-slice",
+    "cloud.google.com/gke-tpu-topology",
+)
+
+#: Annotation value for "true" booleans (reference uses "true" strings).
+TRUE_STRING = "true"
+
+#: Value that deletes an annotation via ChangeNodeUpgradeAnnotation —
+#: reference uses a literal "null" sentinel turned into a JSON merge-patch
+#: null (node_upgrade_state_provider.go:147-151).
+NULL_STRING = "null"

@@ -1,0 +1,235 @@
+"""Concurrency primitives, key builders and event helpers.
+
+Reference parity: ``pkg/upgrade/util.go`` —
+
+* ``StringSet``   (util.go:29-70)  — mutex-guarded set used to dedupe
+  in-flight drains/evictions across reconcile cycles;
+* ``KeyedMutex``  (util.go:72-89)  — per-key lock (returns an unlock
+  closure in Go; here a context manager);
+* ``SetDriverName`` (util.go:91-99) — process-global component name that
+  parameterizes every label/annotation key (we call it *component name*);
+* key-builder funcs (util.go:102-155);
+* event-reason builder + nil-safe event emission (util.go:157-177).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+from . import consts
+
+
+# --------------------------------------------------------------------------
+# Concurrency primitives (reference C14)
+# --------------------------------------------------------------------------
+
+
+class StringSet:
+    """Thread-safe string set.
+
+    Used by :class:`~..drain_manager.DrainManager` and
+    :class:`~..pod_manager.PodManager` to deduplicate nodes that already
+    have an async operation in flight (reference: util.go:29-70,
+    drain_manager.go:98-137).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._items: set[str] = set()
+
+    def add(self, item: str) -> None:
+        with self._lock:
+            self._items.add(item)
+
+    def remove(self, item: str) -> None:
+        with self._lock:
+            self._items.discard(item)
+
+    def has(self, item: str) -> bool:
+        with self._lock:
+            return item in self._items
+
+    def add_if_absent(self, item: str) -> bool:
+        """Atomically add *item*; return True if it was newly added.
+
+        The Go reference checks ``Has`` then ``Add`` under the caller's
+        single-reconcile-goroutine assumption; we make the test-and-set
+        atomic so the scheduling API is safe under concurrent reconciles.
+        """
+        with self._lock:
+            if item in self._items:
+                return False
+            self._items.add(item)
+            return True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+class KeyedMutex:
+    """Per-key mutual exclusion (reference: util.go:72-89).
+
+    The reference stores ``sync.Mutex`` values in a ``sync.Map`` and returns
+    an unlock closure; here :meth:`lock` is a context manager::
+
+        with keyed.lock(node_name):
+            ...patch node...
+    """
+
+    def __init__(self) -> None:
+        self._guard = threading.Lock()
+        self._locks: Dict[str, threading.Lock] = {}
+
+    def _get(self, key: str) -> threading.Lock:
+        with self._guard:
+            lk = self._locks.get(key)
+            if lk is None:
+                lk = threading.Lock()
+                self._locks[key] = lk
+            return lk
+
+    @contextmanager
+    def lock(self, key: str) -> Iterator[None]:
+        lk = self._get(key)
+        lk.acquire()
+        try:
+            yield
+        finally:
+            lk.release()
+
+
+# --------------------------------------------------------------------------
+# Component-name global + key builders (reference C13 half)
+# --------------------------------------------------------------------------
+
+_component_name = "tpu-runtime"
+_component_lock = threading.Lock()
+
+
+def set_component_name(name: str) -> None:
+    """Set the process-global managed-component name.
+
+    Reference: ``SetDriverName`` (util.go:91-99) — set once by the consumer
+    before any manager is constructed; parameterizes every label/annotation
+    key (e.g. ``tpu.google.com/<name>-upgrade-state``).
+    """
+    if not name:
+        raise ValueError("component name must be non-empty")
+    global _component_name
+    with _component_lock:
+        _component_name = name
+
+
+def get_component_name() -> str:
+    with _component_lock:
+        return _component_name
+
+
+def get_upgrade_state_label_key() -> str:
+    """Reference: GetUpgradeStateLabelKey (util.go:102-105)."""
+    return consts.UPGRADE_STATE_LABEL_KEY_FMT % get_component_name()
+
+
+def get_upgrade_skip_node_label_key() -> str:
+    return consts.UPGRADE_SKIP_NODE_LABEL_KEY_FMT % get_component_name()
+
+def get_upgrade_requested_annotation_key() -> str:
+    return consts.UPGRADE_REQUESTED_ANNOTATION_KEY_FMT % get_component_name()
+
+
+def get_upgrade_initial_state_annotation_key() -> str:
+    return consts.UPGRADE_INITIAL_STATE_ANNOTATION_KEY_FMT % get_component_name()
+
+
+def get_wait_for_safe_load_annotation_key() -> str:
+    return (
+        consts.UPGRADE_WAIT_FOR_SAFE_LOAD_ANNOTATION_KEY_FMT % get_component_name()
+    )
+
+
+def get_wait_for_pod_completion_start_time_annotation_key() -> str:
+    return (
+        consts.UPGRADE_WAIT_FOR_POD_COMPLETION_START_TIME_ANNOTATION_KEY_FMT
+        % get_component_name()
+    )
+
+
+def get_validation_start_time_annotation_key() -> str:
+    return (
+        consts.UPGRADE_VALIDATION_START_TIME_ANNOTATION_KEY_FMT
+        % get_component_name()
+    )
+
+
+def get_upgrade_requestor_mode_annotation_key() -> str:
+    """Reference: GetUpgradeRequestorModeAnnotationKey (util.go:134-138)."""
+    return consts.UPGRADE_REQUESTOR_MODE_ANNOTATION_KEY_FMT % get_component_name()
+
+
+def get_pre_drain_checkpoint_annotation_key() -> str:
+    """TPU-native: checkpoint-on-drain handshake annotation key."""
+    return consts.PRE_DRAIN_CHECKPOINT_ANNOTATION_KEY_FMT % get_component_name()
+
+
+def get_event_reason() -> str:
+    """Reference: GetEventReason (util.go:157-160)."""
+    return "%sUpgrade" % get_component_name()
+
+
+# --------------------------------------------------------------------------
+# Events (reference: util.go:162-177 — nil-safe logEvent helpers)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Event:
+    """A recorded Kubernetes-style Event."""
+
+    object_name: str
+    event_type: str  # "Normal" | "Warning"
+    reason: str
+    message: str
+
+
+class EventRecorder:
+    """In-process stand-in for ``record.EventRecorder``.
+
+    The reference emits real Kubernetes Events via a controller-runtime
+    recorder and wraps every call in nil-safe helpers (util.go:162-177);
+    tests use ``record.NewFakeRecorder(100)`` (upgrade_suit_test.go:69).
+    This recorder is both — consumers may subclass to forward to a real
+    event sink.
+    """
+
+    def __init__(self, capacity: int = 1000) -> None:
+        self._lock = threading.Lock()
+        self._capacity = capacity
+        self.events: List[Event] = []
+
+    def event(self, obj_name: str, event_type: str, reason: str, message: str) -> None:
+        with self._lock:
+            self.events.append(Event(obj_name, event_type, reason, message))
+            if len(self.events) > self._capacity:
+                self.events.pop(0)
+
+    # -- query helpers for tests -------------------------------------------
+    def messages(self) -> List[str]:
+        with self._lock:
+            return [e.message for e in self.events]
+
+
+def log_event(
+    recorder: Optional[EventRecorder],
+    obj_name: str,
+    event_type: str,
+    reason: str,
+    message: str,
+) -> None:
+    """Nil-safe event emission (reference: util.go:162-177)."""
+    if recorder is None:
+        return
+    recorder.event(obj_name, event_type, reason, message)

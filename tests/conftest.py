@@ -1,0 +1,45 @@
+"""Shared pytest fixtures.
+
+Mirrors the reference suite bootstrap (upgrade_suit_test.go): component
+name fixed to "tpu-runtime" (reference sets driver name "gpu",
+upgrade_suit_test.go:112), a fresh in-memory cluster per test (reference
+does per-test GC in AfterEach, :195-214), a fake event recorder (:69).
+
+JAX tests run on a virtual 8-device CPU mesh — env vars must be set
+before jax is first imported anywhere in the process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+from k8s_operator_libs_tpu.cluster import InformerCache, InMemoryCluster
+from k8s_operator_libs_tpu.upgrade import util
+
+
+@pytest.fixture(autouse=True)
+def component_name():
+    util.set_component_name("tpu-runtime")
+    yield "tpu-runtime"
+
+
+@pytest.fixture()
+def cluster():
+    return InMemoryCluster()
+
+
+@pytest.fixture()
+def cache(cluster):
+    return InformerCache(cluster, lag_seconds=0.0)
+
+
+@pytest.fixture()
+def recorder():
+    return util.EventRecorder()

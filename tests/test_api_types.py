@@ -1,0 +1,97 @@
+"""API-type tests: defaults, validation, IntOrString scaling, JSON round-trip.
+
+Reference behavior under test: kubebuilder defaults/validation markers in
+api/upgrade/v1alpha1/upgrade_spec.go:27-110 and the percent resolution at
+upgrade_inplace.go:54-60 (GetScaledValueFromIntOrPercent, roundUp=true).
+"""
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    PodDeletionSpec,
+    UpgradePolicySpec,
+    ValidationError,
+    WaitForCompletionSpec,
+)
+
+
+class TestIntOrString:
+    def test_int_passthrough(self):
+        assert IntOrString(5).scaled_value(100) == 5
+
+    @pytest.mark.parametrize(
+        "pct,total,expect",
+        [
+            ("25%", 4, 1),
+            ("25%", 5, 2),  # round up
+            ("10%", 9, 1),
+            ("0%", 10, 0),
+            ("100%", 7, 7),
+            ("50%", 3, 2),
+        ],
+    )
+    def test_percent_round_up(self, pct, total, expect):
+        assert IntOrString(pct).scaled_value(total, round_up=True) == expect
+
+    def test_percent_round_down(self):
+        assert IntOrString("50%").scaled_value(3, round_up=False) == 1
+
+    def test_rejects_garbage_string(self):
+        with pytest.raises(ValueError):
+            IntOrString("banana")
+
+    def test_rejects_bool(self):
+        with pytest.raises(TypeError):
+            IntOrString(True)
+
+
+class TestDefaults:
+    def test_policy_defaults_match_reference(self):
+        p = UpgradePolicySpec()
+        assert p.auto_upgrade is False
+        assert p.max_parallel_upgrades == 1
+        assert p.max_unavailable == IntOrString("25%")
+        assert p.pod_deletion is None and p.drain_spec is None
+
+    def test_sub_spec_defaults(self):
+        assert PodDeletionSpec().timeout_second == 300
+        assert DrainSpec().timeout_second == 300
+        assert WaitForCompletionSpec().timeout_second == 0
+        assert DrainSpec().enable is False
+
+    def test_validation_rejects_negatives(self):
+        with pytest.raises(ValidationError):
+            UpgradePolicySpec(max_parallel_upgrades=-1).validate()
+        with pytest.raises(ValidationError):
+            UpgradePolicySpec(drain_spec=DrainSpec(timeout_second=-5)).validate()
+
+    def test_coerces_raw_max_unavailable(self):
+        assert UpgradePolicySpec(max_unavailable="40%").max_unavailable.is_percent
+        assert UpgradePolicySpec(max_unavailable=3).max_unavailable.value == 3
+
+
+class TestRoundTrip:
+    def test_json_round_trip_camel_case(self):
+        p = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=4,
+            max_unavailable=IntOrString("40%"),
+            pod_deletion=PodDeletionSpec(force=True, delete_empty_dir=True),
+            wait_for_completion=WaitForCompletionSpec(
+                pod_selector="app=training", timeout_second=60
+            ),
+            drain_spec=DrainSpec(enable=True, pod_selector="app!=infra"),
+        )
+        d = p.to_dict()
+        assert d["maxUnavailable"] == "40%"
+        assert d["podDeletion"]["deleteEmptyDir"] is True
+        assert d["drain"]["podSelector"] == "app!=infra"
+        back = UpgradePolicySpec.from_dict(d)
+        assert back == p
+
+    def test_from_empty_dict_uses_defaults(self):
+        p = UpgradePolicySpec.from_dict({})
+        assert p.max_parallel_upgrades == 1
+        assert p.max_unavailable == IntOrString("25%")

@@ -1,0 +1,253 @@
+"""In-memory apiserver + informer cache + selector + retry tests.
+
+This substrate is the envtest analog; its optimistic-concurrency and
+merge-patch semantics are load-bearing for everything above it
+(NodeUpgradeStateProvider's null-deletion patches, requestor-mode's
+RV-guarded AdditionalRequestors patch), so they get their own suite.
+"""
+
+import threading
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.cluster import (
+    AlreadyExistsError,
+    ConflictError,
+    InformerCache,
+    InMemoryCluster,
+    NotFoundError,
+    is_conflict,
+    is_not_found,
+    matches,
+    parse_selector,
+    retry_on_conflict,
+)
+from k8s_operator_libs_tpu.cluster.objects import make_node, make_pod
+
+
+class TestSelectors:
+    @pytest.mark.parametrize(
+        "sel,labels,expect",
+        [
+            ("", {}, True),
+            ("a=b", {"a": "b"}, True),
+            ("a=b", {"a": "c"}, False),
+            ("a==b", {"a": "b"}, True),
+            ("a!=b", {"a": "c"}, True),
+            ("a!=b", {}, True),  # k8s: != matches objects without the key
+            ("a", {"a": "anything"}, True),
+            ("a", {}, False),
+            ("!a", {}, True),
+            ("!a", {"a": "x"}, False),
+            ("a in (x,y)", {"a": "y"}, True),
+            ("a in (x,y)", {"a": "z"}, False),
+            ("a notin (x,y)", {"a": "z"}, True),
+            ("a notin (x,y)", {}, False),  # notin requires key to exist
+            ("a=b,c=d", {"a": "b", "c": "d"}, True),
+            ("a=b,c=d", {"a": "b"}, False),
+            ("app in (train, infer),tier!=dev", {"app": "train", "tier": "prod"}, True),
+        ],
+    )
+    def test_matching(self, sel, labels, expect):
+        assert matches(sel, labels) is expect
+
+    def test_parse_error(self):
+        from k8s_operator_libs_tpu.cluster.selectors import SelectorParseError
+
+        with pytest.raises(SelectorParseError):
+            parse_selector("a=b=c=>nope<")
+
+
+class TestCrud:
+    def test_create_get_roundtrip_and_deepcopy(self, cluster):
+        node = make_node("n1", labels={"role": "tpu"})
+        created = cluster.create(node)
+        assert created["metadata"]["resourceVersion"] == "1"
+        got = cluster.get("Node", "n1")
+        got["metadata"]["labels"]["role"] = "mutated"
+        assert cluster.get("Node", "n1")["metadata"]["labels"]["role"] == "tpu"
+
+    def test_create_duplicate(self, cluster):
+        cluster.create(make_node("n1"))
+        with pytest.raises(AlreadyExistsError):
+            cluster.create(make_node("n1"))
+
+    def test_get_missing(self, cluster):
+        with pytest.raises(NotFoundError) as ei:
+            cluster.get("Node", "nope")
+        assert is_not_found(ei.value)
+
+    def test_list_by_label_and_namespace(self, cluster):
+        cluster.create(make_pod("p1", "ns-a", "n1", labels={"app": "x"}))
+        cluster.create(make_pod("p2", "ns-a", "n1", labels={"app": "y"}))
+        cluster.create(make_pod("p3", "ns-b", "n2", labels={"app": "x"}))
+        assert len(cluster.list("Pod")) == 3
+        assert len(cluster.list("Pod", namespace="ns-a")) == 2
+        assert [p["metadata"]["name"] for p in cluster.list("Pod", label_selector="app=x")] == [
+            "p1",
+            "p3",
+        ]
+
+    def test_update_conflict_on_stale_rv(self, cluster):
+        cluster.create(make_node("n1"))
+        a = cluster.get("Node", "n1")
+        b = cluster.get("Node", "n1")
+        a["spec"]["unschedulable"] = True
+        cluster.update(a)
+        b["spec"]["unschedulable"] = False
+        with pytest.raises(ConflictError) as ei:
+            cluster.update(b)
+        assert is_conflict(ei.value)
+
+    def test_delete(self, cluster):
+        cluster.create(make_node("n1"))
+        cluster.delete("Node", "n1")
+        with pytest.raises(NotFoundError):
+            cluster.delete("Node", "n1")
+
+
+class TestMergePatch:
+    def test_label_add_and_null_deletion(self, cluster):
+        cluster.create(make_node("n1", annotations={"keep": "1", "drop": "2"}))
+        cluster.patch(
+            "Node",
+            "n1",
+            {"metadata": {"annotations": {"drop": None, "new": "3"}}},
+        )
+        ann = cluster.get("Node", "n1")["metadata"]["annotations"]
+        assert ann == {"keep": "1", "new": "3"}
+
+    def test_patch_with_rv_enforces_optimistic_lock(self, cluster):
+        cluster.create(make_node("n1"))
+        obj = cluster.get("Node", "n1")
+        stale_rv = obj["metadata"]["resourceVersion"]
+        cluster.patch("Node", "n1", {"metadata": {"labels": {"a": "1"}}})
+        with pytest.raises(ConflictError):
+            cluster.patch(
+                "Node",
+                "n1",
+                {"metadata": {"resourceVersion": stale_rv, "labels": {"b": "2"}}},
+            )
+
+    def test_patch_without_rv_is_last_write_wins(self, cluster):
+        cluster.create(make_node("n1"))
+        cluster.patch("Node", "n1", {"metadata": {"labels": {"a": "1"}}})
+        cluster.patch("Node", "n1", {"metadata": {"labels": {"b": "2"}}})
+        labels = cluster.get("Node", "n1")["metadata"]["labels"]
+        assert labels == {"a": "1", "b": "2"}
+
+
+class TestJournal:
+    def test_delete_event_gets_own_seq(self, cluster):
+        # Regression: a Deleted event must advance the sequence so a watcher
+        # checkpointed at the previous write still sees the deletion.
+        cluster.create(make_node("n1"))
+        cluster.patch("Node", "n1", {"metadata": {"labels": {"x": "1"}}})
+        checkpoint = cluster.journal_seq()
+        cluster.delete("Node", "n1")
+        evs = cluster.events_since(checkpoint)
+        assert [e.type for e in evs] == ["Deleted"]
+
+    def test_expired_watch_window_raises_gone(self, cluster):
+        from k8s_operator_libs_tpu.cluster import ExpiredError
+
+        cluster._journal_cap = 5
+        for i in range(20):
+            cluster.create(make_node(f"n{i}"))
+        with pytest.raises(ExpiredError):
+            cluster.events_since(0)
+
+    def test_patch_cannot_mutate_identity(self, cluster):
+        cluster.create(make_node("n1"))
+        cluster.patch(
+            "Node", "n1", {"kind": "Gadget", "metadata": {"namespace": "ns-x"}}
+        )
+        obj = cluster.get("Node", "n1")
+        assert obj["kind"] == "Node"
+        assert "namespace" not in obj["metadata"]
+
+    def test_events_since(self, cluster):
+        seq0 = cluster.journal_seq()
+        cluster.create(make_node("n1"))
+        cluster.patch("Node", "n1", {"metadata": {"labels": {"x": "1"}}})
+        cluster.delete("Node", "n1")
+        evs = cluster.events_since(seq0, kind="Node")
+        assert [e.type for e in evs] == ["Added", "Modified", "Deleted"]
+        assert evs[1].old["metadata"]["labels"] != evs[1].new["metadata"]["labels"]
+
+
+class TestInformerCache:
+    def test_zero_lag_is_fresh(self, cluster):
+        cache = InformerCache(cluster, lag_seconds=0.0)
+        cluster.create(make_node("n1"))
+        assert cache.get("Node", "n1")["metadata"]["name"] == "n1"
+
+    def test_lagged_cache_serves_stale_then_syncs(self, cluster):
+        cache = InformerCache(cluster, lag_seconds=10.0)  # effectively frozen
+        cluster.create(make_node("n1"))
+        with pytest.raises(NotFoundError):
+            cache.get("Node", "n1")
+        cache.sync()
+        assert cache.get("Node", "n1")
+
+    def test_lag_expiry_triggers_resync(self, cluster):
+        cache = InformerCache(cluster, lag_seconds=0.05)
+        cluster.create(make_node("n1"))
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            try:
+                cache.get("Node", "n1")
+                break
+            except NotFoundError:
+                time.sleep(0.01)
+        else:
+            pytest.fail("cache never resynced after lag expiry")
+
+
+class TestRetryOnConflict:
+    def test_retries_until_success(self, cluster):
+        cluster.create(make_node("n1", labels={"count": "0"}))
+        barrier = threading.Barrier(2)
+
+        def contender():
+            for _ in range(3):
+                def attempt():
+                    obj = cluster.get("Node", "n1")
+                    obj["metadata"]["labels"]["count"] = str(
+                        int(obj["metadata"]["labels"]["count"]) + 1
+                    )
+                    cluster.update(obj)
+                barrier.wait()
+                retry_on_conflict(attempt)
+
+        t1 = threading.Thread(target=contender)
+        t2 = threading.Thread(target=contender)
+        t1.start(); t2.start(); t1.join(); t2.join()
+        assert cluster.get("Node", "n1")["metadata"]["labels"]["count"] == "6"
+
+    def test_owner_reference_uid_shared_between_siblings(self, cluster):
+        from k8s_operator_libs_tpu.cluster.objects import (
+            is_owned_by,
+            make_daemonset,
+        )
+
+        ds = {"kind": "DaemonSet", "metadata": {"name": "d", "namespace": "ns"}}
+        p1 = make_pod("p1", "ns", "n1", owner=ds)
+        p2 = make_pod("p2", "ns", "n1", owner=ds)
+        assert is_owned_by(p1, ds) and is_owned_by(p2, ds)
+        assert (
+            p1["metadata"]["ownerReferences"][0]["uid"]
+            == p2["metadata"]["ownerReferences"][0]["uid"]
+        )
+
+    def test_gives_up_after_steps(self):
+        calls = {"n": 0}
+
+        def always_conflict():
+            calls["n"] += 1
+            raise ConflictError("nope")
+
+        with pytest.raises(ConflictError):
+            retry_on_conflict(always_conflict, steps=3, base_seconds=0.0)
+        assert calls["n"] == 3

@@ -1,0 +1,100 @@
+"""Tests for StringSet, KeyedMutex, key builders, event helpers.
+
+Reference behavior under test: pkg/upgrade/util.go:29-177.
+"""
+
+import threading
+
+from k8s_operator_libs_tpu.upgrade import consts, util
+
+
+class TestStringSet:
+    def test_basic(self):
+        s = util.StringSet()
+        assert not s.has("a")
+        s.add("a")
+        assert s.has("a") and len(s) == 1
+        s.remove("a")
+        assert not s.has("a")
+        s.remove("a")  # idempotent
+
+    def test_add_if_absent_atomicity(self):
+        s = util.StringSet()
+        wins = []
+
+        def worker():
+            if s.add_if_absent("node-1"):
+                wins.append(1)
+
+        threads = [threading.Thread(target=worker) for _ in range(32)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert len(wins) == 1
+
+
+class TestKeyedMutex:
+    def test_per_key_serialization(self):
+        km = util.KeyedMutex()
+        counter = {"n": 0}
+
+        def bump():
+            with km.lock("node-a"):
+                v = counter["n"]
+                counter["n"] = v + 1
+
+        threads = [threading.Thread(target=bump) for _ in range(50)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert counter["n"] == 50
+
+    def test_different_keys_independent(self):
+        km = util.KeyedMutex()
+        order = []
+        inner_done = threading.Event()
+
+        def other():
+            with km.lock("b"):
+                order.append("b")
+                inner_done.set()
+
+        with km.lock("a"):
+            t = threading.Thread(target=other)
+            t.start()
+            assert inner_done.wait(timeout=2.0)  # 'b' not blocked by 'a'
+            t.join()
+        assert order == ["b"]
+
+
+class TestKeys:
+    def test_key_builders_parameterized_by_component(self):
+        util.set_component_name("libtpu")
+        assert util.get_upgrade_state_label_key() == (
+            "tpu.google.com/libtpu-upgrade-state"
+        )
+        assert util.get_event_reason() == "libtpuUpgrade"
+        assert "libtpu" in util.get_upgrade_requestor_mode_annotation_key()
+        assert "libtpu" in util.get_pre_drain_checkpoint_annotation_key()
+
+    def test_rejects_empty_name(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            util.set_component_name("")
+
+    def test_state_vocabulary_complete(self):
+        # 13 states incl. unknown — reference consts.go:48-83.
+        assert len(consts.ALL_STATES) == 13
+        assert consts.UPGRADE_STATE_UNKNOWN == ""
+        assert consts.UPGRADE_STATE_DONE == "upgrade-done"
+        assert consts.UPGRADE_STATE_POST_MAINTENANCE_REQUIRED in consts.ALL_STATES
+
+
+class TestEvents:
+    def test_nil_safe_log_event(self):
+        util.log_event(None, "n", "Normal", "r", "m")  # must not raise
+
+    def test_recorder_capacity(self):
+        r = util.EventRecorder(capacity=3)
+        for i in range(5):
+            util.log_event(r, "n", "Normal", "r", f"m{i}")
+        assert r.messages() == ["m2", "m3", "m4"]
