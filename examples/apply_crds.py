@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Example CLI: apply or delete CRDs from YAML files/directories.
+
+Reference parity: ``examples/apply-crds/main.go:34-61`` — a flag-driven
+wrapper over the crdutil package; consumers containerize this pattern as a
+Helm pre-install/pre-upgrade hook (pkg/crdutil/README.md:30-63).
+
+Because this environment has no live kube-apiserver, the CLI runs against
+the library's in-memory apiserver and can persist its state to a JSON file
+between invocations (``--state-file``), so apply → delete flows are
+observable across runs:
+
+    python examples/apply_crds.py --crds-path hack/crd/bases --state-file /tmp/s.json
+    python examples/apply_crds.py --crds-path hack/crd/bases --operation delete \
+        --state-file /tmp/s.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from k8s_operator_libs_tpu.cluster.inmem import InMemoryCluster
+from k8s_operator_libs_tpu.crdutil import (
+    CRDProcessorConfig,
+    OPERATION_APPLY,
+    OPERATION_DELETE,
+    discovery,
+    process_crds_with_config,
+)
+
+
+def load_cluster(state_file: str | None) -> InMemoryCluster:
+    if state_file and os.path.exists(state_file):
+        with open(state_file, "r", encoding="utf-8") as fh:
+            return InMemoryCluster.from_dict(json.load(fh))
+    return InMemoryCluster()
+
+
+def save_cluster(cluster: InMemoryCluster, state_file: str | None) -> None:
+    if not state_file:
+        return
+    with open(state_file, "w", encoding="utf-8") as fh:
+        json.dump(cluster.to_dict(), fh, indent=2, default=str)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    # Flag names mirror the reference CLI (examples/apply-crds/main.go:34-38).
+    parser.add_argument(
+        "--crds-path",
+        action="append",
+        required=True,
+        help="file or directory containing CRD YAML (repeatable)",
+    )
+    parser.add_argument(
+        "--operation",
+        choices=[OPERATION_APPLY, OPERATION_DELETE],
+        default=OPERATION_APPLY,
+    )
+    parser.add_argument(
+        "--ready-timeout-seconds", type=float, default=10.0,
+        help="how long to wait for applied CRDs to be served",
+    )
+    parser.add_argument(
+        "--state-file",
+        default=None,
+        help="JSON file persisting the in-memory cluster between runs",
+    )
+    args = parser.parse_args(argv)
+
+    cluster = load_cluster(args.state_file)
+    config = CRDProcessorConfig(
+        paths=args.crds_path,
+        operation=args.operation,
+        ready_timeout_seconds=args.ready_timeout_seconds,
+    )
+    try:
+        crds = process_crds_with_config(cluster, config)
+    except Exception as err:  # mirror the reference's fatal-log exit
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    save_cluster(cluster, args.state_file)
+
+    names = [c["metadata"]["name"] for c in crds]
+    print(f"{args.operation}: processed {len(crds)} CRD(s): {', '.join(names)}")
+    if args.operation == OPERATION_APPLY:
+        print("served:", ", ".join("/".join(t) for t in sorted(discovery(cluster))))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,13 +86,15 @@ class WatchEvent:
 class InMemoryCluster:
     """A stand-in kube-apiserver holding typed-but-schemaless JSON objects."""
 
-    def __init__(self) -> None:
+    def __init__(self, crd_establish_delay_seconds: float = 0.0) -> None:
         self._lock = threading.RLock()
         self._store: Dict[Key, JsonObj] = {}
         self._rv = 0
         self._journal: List[WatchEvent] = []
         self._journal_cap = 10000
         self._journal_floor = 0  # highest seq evicted from the journal
+        #: A real apiserver establishes CRDs asynchronously; 0 = synchronous.
+        self.crd_establish_delay_seconds = crd_establish_delay_seconds
 
     # ------------------------------------------------------------------ util
     def _next_rv(self) -> str:
@@ -119,7 +121,37 @@ class InMemoryCluster:
             meta.setdefault("creationTimestamp", time.time())
             self._store[key] = stored
             self._record("Added", None, copy.deepcopy(stored))
-            return copy.deepcopy(stored)
+            result = copy.deepcopy(stored)
+        if stored.get("kind") == "CustomResourceDefinition":
+            self._schedule_crd_establishment(key)
+        return result
+
+    # CRD establishment — mimics the apiserver's async naming/serving
+    # controller so crdutil's discovery readiness wait (crdutil.go:275-319
+    # analog) has something real to wait for.
+    def _schedule_crd_establishment(self, key: Key) -> None:
+        def establish() -> None:
+            with self._lock:
+                obj = self._store.get(key)
+                if obj is None:
+                    return
+                old = copy.deepcopy(obj)
+                conds = obj.setdefault("status", {}).setdefault("conditions", [])
+                for c in conds:
+                    if c.get("type") == "Established":
+                        c["status"] = "True"
+                        break
+                else:
+                    conds.append({"type": "Established", "status": "True"})
+                obj["metadata"]["resourceVersion"] = self._next_rv()
+                self._record("Modified", old, copy.deepcopy(obj))
+
+        if self.crd_establish_delay_seconds <= 0:
+            establish()
+        else:
+            t = threading.Timer(self.crd_establish_delay_seconds, establish)
+            t.daemon = True
+            t.start()
 
     def get(self, kind: str, name: str, namespace: str = "") -> JsonObj:
         with self._lock:
@@ -254,3 +286,36 @@ class InMemoryCluster:
         """Deep-copied point-in-time view of the whole store (informer sync)."""
         with self._lock:
             return copy.deepcopy(self._store)
+
+    # ------------------------------------------------------- persistence API
+    def to_dict(self) -> JsonObj:
+        """Serializable dump of the cluster (see :meth:`from_dict`)."""
+        with self._lock:
+            return {
+                "rv": self._rv,
+                "objects": list(copy.deepcopy(self._store).values()),
+            }
+
+    @classmethod
+    def from_dict(cls, data: JsonObj, **kwargs: Any) -> "InMemoryCluster":
+        """Restore a cluster previously dumped with :meth:`to_dict`.
+
+        Objects are restored verbatim (resourceVersions preserved); CRDs
+        without an Established condition get establishment re-scheduled,
+        matching an apiserver restart.
+        """
+        cluster = cls(**kwargs)
+        with cluster._lock:
+            cluster._rv = int(data.get("rv", 0))
+            for obj in data.get("objects", []):
+                key = _key_of(obj)
+                cluster._store[key] = copy.deepcopy(obj)
+        for obj in data.get("objects", []):
+            if obj.get("kind") == "CustomResourceDefinition":
+                conds = (obj.get("status") or {}).get("conditions") or []
+                if not any(
+                    c.get("type") == "Established" and c.get("status") == "True"
+                    for c in conds
+                ):
+                    cluster._schedule_crd_establishment(_key_of(obj))
+        return cluster

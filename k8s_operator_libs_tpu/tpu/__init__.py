@@ -1,0 +1,8 @@
+"""TPU-native extensions: slice topology, checkpoint-drain, demo workload.
+
+Modules land incrementally:
+
+* ``topology``        — slice/failure-domain grouping for the throttle
+* ``drain_handshake`` — checkpoint-on-drain annotation protocol
+* ``workload``        — demo SPMD JAX trainer integrating both
+"""

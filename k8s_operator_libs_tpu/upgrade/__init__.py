@@ -1,0 +1,5 @@
+"""The rolling-upgrade state machine (reference: pkg/upgrade)."""
+
+from . import consts, util
+
+__all__ = ["consts", "util"]

@@ -1,0 +1,179 @@
+"""crdutil tests: walk/parse/apply/update/delete/idempotency/ready-wait.
+
+Reference spec coverage: pkg/crdutil/crdutil_test.go (264 LoC) —
+apply/update/delete/idempotency/recursive-walk/multi-path against the
+test-files fixtures — plus the async-establishment readiness wait that
+envtest gives the reference for free.
+"""
+
+import os
+
+import pytest
+
+from k8s_operator_libs_tpu.cluster import InMemoryCluster
+from k8s_operator_libs_tpu.crdutil import (
+    CRD_KIND,
+    CRDProcessingError,
+    CRDProcessorConfig,
+    OPERATION_APPLY,
+    OPERATION_DELETE,
+    discovery,
+    parse_crds_from_file,
+    process_crds,
+    process_crds_with_config,
+    walk_crd_paths,
+)
+
+HERE = os.path.dirname(__file__)
+FIXTURES = os.path.join(HERE, "test-files")
+CRDS_YAML = os.path.join(FIXTURES, "crds.yaml")
+UPDATED_YAML = os.path.join(FIXTURES, "updated-crds.yaml")
+NM_CRD = os.path.join(
+    HERE, "..", "hack", "crd", "bases",
+    "maintenance.tpu.google.com_nodemaintenances.yaml",
+)
+
+
+class TestWalkAndParse:
+    def test_recursive_walk_yaml_only(self, tmp_path):
+        (tmp_path / "a.yaml").write_text("kind: CustomResourceDefinition\nmetadata: {name: a.x}\nspec: {}\n")
+        (tmp_path / "b.yml").write_text("kind: ConfigMap\n")
+        (tmp_path / "c.txt").write_text("not yaml")
+        sub = tmp_path / "deep" / "deeper"
+        sub.mkdir(parents=True)
+        (sub / "d.yaml").write_text("kind: ConfigMap\n")
+        files = walk_crd_paths([str(tmp_path)])
+        assert [os.path.basename(f) for f in files] == ["a.yaml", "b.yml", "d.yaml"]
+
+    def test_missing_path_errors(self):
+        with pytest.raises(CRDProcessingError):
+            walk_crd_paths(["/does/not/exist"])
+
+    def test_multi_doc_skips_non_crds(self):
+        crds = parse_crds_from_file(CRDS_YAML)
+        assert [c["metadata"]["name"] for c in crds] == [
+            "widgets.example.tpu.google.com",
+            "gadgets.example.tpu.google.com",
+        ]
+
+    def test_invalid_yaml_is_error(self, tmp_path):
+        bad = tmp_path / "bad.yaml"
+        bad.write_text("kind: [unclosed\n")
+        with pytest.raises(CRDProcessingError):
+            parse_crds_from_file(str(bad))
+
+    def test_nameless_crd_is_error(self, tmp_path):
+        bad = tmp_path / "nameless.yaml"
+        bad.write_text("kind: CustomResourceDefinition\nmetadata: {}\n")
+        with pytest.raises(CRDProcessingError):
+            parse_crds_from_file(str(bad))
+
+
+class TestApplyDelete:
+    def test_apply_creates_and_serves(self, cluster):
+        crds = process_crds(cluster, OPERATION_APPLY, CRDS_YAML)
+        assert len(crds) == 2
+        assert cluster.exists(CRD_KIND, "widgets.example.tpu.google.com")
+        assert ("example.tpu.google.com", "v1", "widgets") in discovery(cluster)
+
+    def test_apply_is_idempotent(self, cluster):
+        process_crds(cluster, OPERATION_APPLY, CRDS_YAML)
+        process_crds(cluster, OPERATION_APPLY, CRDS_YAML)
+        assert len(cluster.list(CRD_KIND)) == 2
+
+    def test_apply_updates_existing(self, cluster):
+        process_crds(cluster, OPERATION_APPLY, CRDS_YAML)
+        process_crds(cluster, OPERATION_APPLY, UPDATED_YAML)
+        crd = cluster.get(CRD_KIND, "widgets.example.tpu.google.com")
+        versions = [v["name"] for v in crd["spec"]["versions"]]
+        assert versions == ["v1", "v2"]
+        # update must not clobber server-managed status
+        assert any(
+            c["type"] == "Established" and c["status"] == "True"
+            for c in crd["status"]["conditions"]
+        )
+        assert ("example.tpu.google.com", "v2", "widgets") in discovery(cluster)
+
+    def test_delete_and_idempotent_delete(self, cluster):
+        process_crds(cluster, OPERATION_APPLY, CRDS_YAML)
+        process_crds(cluster, OPERATION_DELETE, CRDS_YAML)
+        assert cluster.list(CRD_KIND) == []
+        process_crds(cluster, OPERATION_DELETE, CRDS_YAML)  # no error
+
+    def test_multiple_paths_incl_nested_dir(self, cluster):
+        process_crds(cluster, OPERATION_APPLY, CRDS_YAML, FIXTURES)
+        names = {c["metadata"]["name"] for c in cluster.list(CRD_KIND)}
+        assert "sprockets.example.tpu.google.com" in names
+
+    def test_unknown_operation(self, cluster):
+        with pytest.raises(CRDProcessingError):
+            process_crds(cluster, "upsert", CRDS_YAML)
+
+    def test_nodemaintenance_fixture_applies(self, cluster):
+        process_crds(cluster, OPERATION_APPLY, NM_CRD)
+        assert (
+            "maintenance.tpu.google.com",
+            "v1alpha1",
+            "nodemaintenances",
+        ) in discovery(cluster)
+
+
+class TestReadyWait:
+    def test_waits_for_async_establishment(self):
+        cluster = InMemoryCluster(crd_establish_delay_seconds=0.15)
+        config = CRDProcessorConfig(
+            paths=[CRDS_YAML],
+            operation=OPERATION_APPLY,
+            ready_timeout_seconds=3.0,
+            ready_poll_seconds=0.02,
+        )
+        process_crds_with_config(cluster, config)  # must not time out
+        assert len(discovery(cluster)) == 2
+
+    def test_timeout_when_never_established(self):
+        cluster = InMemoryCluster(crd_establish_delay_seconds=60.0)
+        config = CRDProcessorConfig(
+            paths=[CRDS_YAML],
+            operation=OPERATION_APPLY,
+            ready_timeout_seconds=0.2,
+            ready_poll_seconds=0.02,
+        )
+        with pytest.raises(CRDProcessingError, match="timed out"):
+            process_crds_with_config(cluster, config)
+
+    def test_skip_ready_wait(self):
+        cluster = InMemoryCluster(crd_establish_delay_seconds=60.0)
+        config = CRDProcessorConfig(
+            paths=[CRDS_YAML], operation=OPERATION_APPLY, skip_ready_wait=True
+        )
+        process_crds_with_config(cluster, config)  # returns immediately
+
+
+class TestExampleCli:
+    def test_apply_then_delete_via_state_file(self, tmp_path):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "apply_crds", os.path.join(HERE, "..", "examples", "apply_crds.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        state = str(tmp_path / "state.json")
+        assert mod.main(["--crds-path", CRDS_YAML, "--state-file", state]) == 0
+        assert mod.main(
+            ["--crds-path", CRDS_YAML, "--operation", "delete", "--state-file", state]
+        ) == 0
+        cluster = mod.load_cluster(state)
+        assert cluster.list("CustomResourceDefinition") == []
+
+    def test_bad_path_exits_nonzero(self, capsys):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "apply_crds2", os.path.join(HERE, "..", "examples", "apply_crds.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main(["--crds-path", "/nope"]) == 1
+        assert "error:" in capsys.readouterr().err
