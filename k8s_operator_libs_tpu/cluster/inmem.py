@@ -201,7 +201,19 @@ class InMemoryCluster:
             stored["metadata"]["creationTimestamp"] = current["metadata"][
                 "creationTimestamp"
             ]
+            if current["metadata"].get("deletionTimestamp"):
+                stored["metadata"]["deletionTimestamp"] = current["metadata"][
+                    "deletionTimestamp"
+                ]
             stored["metadata"]["resourceVersion"] = self._next_rv()
+            # Finalizer semantics: a terminating object whose finalizers are
+            # now empty is removed instead of updated.
+            if stored["metadata"].get("deletionTimestamp") and not stored[
+                "metadata"
+            ].get("finalizers"):
+                self._store.pop(key)
+                self._record("Deleted", old, None)
+                return copy.deepcopy(stored)
             self._store[key] = stored
             self._record("Modified", old, copy.deepcopy(stored))
             return copy.deepcopy(stored)
@@ -243,16 +255,36 @@ class InMemoryCluster:
             else:
                 merged["metadata"].pop("namespace", None)
             merged["metadata"]["resourceVersion"] = self._next_rv()
+            # Finalizer semantics (same as update()): a terminating object
+            # whose finalizers were just cleared is removed, not stored.
+            if merged["metadata"].get("deletionTimestamp") and not merged[
+                "metadata"
+            ].get("finalizers"):
+                self._store.pop(key)
+                self._record("Deleted", old, None)
+                return copy.deepcopy(merged)
             self._store[key] = merged
             self._record("Modified", old, copy.deepcopy(merged))
             return copy.deepcopy(merged)
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        """Delete an object.  Like a real apiserver, an object holding
+        finalizers is only *marked* (deletionTimestamp set); it is removed
+        once its finalizers are cleared via :meth:`update` — this is what
+        makes drain/eviction timeout paths testable."""
         with self._lock:
             key = (kind, namespace, name)
-            obj = self._store.pop(key, None)
+            obj = self._store.get(key)
             if obj is None:
                 raise NotFoundError(f"{key} not found")
+            if (obj.get("metadata") or {}).get("finalizers"):
+                if not obj["metadata"].get("deletionTimestamp"):
+                    old = copy.deepcopy(obj)
+                    obj["metadata"]["deletionTimestamp"] = time.time()
+                    obj["metadata"]["resourceVersion"] = self._next_rv()
+                    self._record("Modified", old, copy.deepcopy(obj))
+                return
+            self._store.pop(key)
             self._next_rv()  # deletions advance the version sequence too
             self._record("Deleted", copy.deepcopy(obj), None)
 

@@ -1,0 +1,46 @@
+"""CordonManager — set/unset ``node.spec.unschedulable``.
+
+Reference parity: ``pkg/upgrade/cordon_manager.go:33-56`` — a thin wrapper
+over ``drain.RunCordonOrUncordon``; no-ops when the node is already in the
+desired schedulability state.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.objects import name_of, node_is_unschedulable
+from . import util
+from .util import EventRecorder, log_event
+
+logger = logging.getLogger(__name__)
+
+
+class CordonManager:
+    def __init__(
+        self, cluster: InMemoryCluster, recorder: Optional[EventRecorder] = None
+    ) -> None:
+        self._cluster = cluster
+        self._recorder = recorder
+
+    def cordon(self, node: JsonObj) -> None:
+        self._set_unschedulable(node, True)
+
+    def uncordon(self, node: JsonObj) -> None:
+        self._set_unschedulable(node, False)
+
+    def _set_unschedulable(self, node: JsonObj, desired: bool) -> None:
+        if node_is_unschedulable(node) == desired:
+            return
+        name = name_of(node)
+        self._cluster.patch("Node", name, {"spec": {"unschedulable": desired}})
+        node.setdefault("spec", {})["unschedulable"] = desired
+        log_event(
+            self._recorder,
+            name,
+            "Normal",
+            util.get_event_reason(),
+            "Node cordoned" if desired else "Node uncordoned",
+        )

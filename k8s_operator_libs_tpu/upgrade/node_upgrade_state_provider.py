@@ -1,0 +1,151 @@
+"""NodeUpgradeStateProvider — the single writer of node upgrade state.
+
+Reference parity: ``pkg/upgrade/node_upgrade_state_provider.go`` —
+
+* per-node ``KeyedMutex`` serialization of all writes (:33-37, C10);
+* state label written with a (strategic) merge patch (:80-82);
+* annotations written with a merge patch where the literal value
+  ``"null"`` becomes a JSON null, i.e. deletion (:147-151);
+* after every write, **poll the informer cache until the write is
+  visible** (≤10 s, 1 s poll — :100-117, 171-197) so the next reconcile
+  never acts on stale state.  The timeout/poll are constructor-tunable
+  here so tests run fast.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from ..cluster.cache import InformerCache
+from ..cluster.errors import NotFoundError
+from ..cluster.inmem import InMemoryCluster, JsonObj
+from . import consts, util
+from .util import EventRecorder, KeyedMutex, log_event
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CACHE_SYNC_TIMEOUT_SECONDS = 10.0
+DEFAULT_CACHE_SYNC_POLL_SECONDS = 1.0
+
+
+class CacheSyncTimeoutError(Exception):
+    """The write never became visible in the informer cache."""
+
+
+class NodeUpgradeStateProvider:
+    """Serialized, cache-visibility-checked node label/annotation writes."""
+
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        cache: InformerCache,
+        recorder: Optional[EventRecorder] = None,
+        cache_sync_timeout_seconds: float = DEFAULT_CACHE_SYNC_TIMEOUT_SECONDS,
+        cache_sync_poll_seconds: float = DEFAULT_CACHE_SYNC_POLL_SECONDS,
+    ) -> None:
+        self._cluster = cluster
+        self._cache = cache
+        self._recorder = recorder
+        self._keyed_mutex = KeyedMutex()
+        self._timeout = cache_sync_timeout_seconds
+        self._poll = cache_sync_poll_seconds
+
+    # ------------------------------------------------------------------ reads
+    def get_node(self, name: str) -> JsonObj:
+        """Cache read (reference: GetNode, :59-68)."""
+        return self._cache.get("Node", name)
+
+    # ----------------------------------------------------------------- writes
+    def change_node_upgrade_state(self, node: JsonObj, new_state: str) -> None:
+        """Set the upgrade-state label and wait until the cache sees it.
+
+        Reference: ChangeNodeUpgradeState (:72-134).  The passed-in node
+        dict is updated in place on success so the caller's snapshot stays
+        coherent within the current reconcile (the reference mutates the
+        shared ``*corev1.Node`` the same way).
+        """
+        name = (node.get("metadata") or {}).get("name", "")
+        key = util.get_upgrade_state_label_key()
+        with self._keyed_mutex.lock(name):
+            if new_state == consts.UPGRADE_STATE_UNKNOWN:
+                patch: JsonObj = {"metadata": {"labels": {key: None}}}
+            else:
+                patch = {"metadata": {"labels": {key: new_state}}}
+            self._cluster.patch("Node", name, patch)
+            self._wait_visible_label(name, key, new_state)
+        node.setdefault("metadata", {}).setdefault("labels", {})
+        if new_state == consts.UPGRADE_STATE_UNKNOWN:
+            node["metadata"]["labels"].pop(key, None)
+        else:
+            node["metadata"]["labels"][key] = new_state
+        log_event(
+            self._recorder,
+            name,
+            "Normal",
+            util.get_event_reason(),
+            f"Node upgrade state set to {new_state or '<unknown>'}",
+        )
+
+    def change_node_upgrade_annotation(
+        self, node: JsonObj, key: str, value: str
+    ) -> None:
+        """Set (or with value "null", delete) a node annotation and wait for
+        cache visibility.
+
+        Reference: ChangeNodeUpgradeAnnotation (:138-216) — the "null"
+        sentinel becomes a JSON merge-patch null, deleting the key.
+        """
+        name = (node.get("metadata") or {}).get("name", "")
+        delete = value == consts.NULL_STRING
+        with self._keyed_mutex.lock(name):
+            patch_value = None if delete else value
+            self._cluster.patch(
+                "Node", name, {"metadata": {"annotations": {key: patch_value}}}
+            )
+            self._wait_visible_annotation(name, key, None if delete else value)
+        node.setdefault("metadata", {}).setdefault("annotations", {})
+        if delete:
+            node["metadata"]["annotations"].pop(key, None)
+        else:
+            node["metadata"]["annotations"][key] = value
+
+    # ------------------------------------------------------------- internals
+    def _wait_visible(self, name: str, predicate) -> None:
+        deadline = time.monotonic() + self._timeout
+        while True:
+            try:
+                cached = self._cache.get("Node", name)
+                if predicate(cached):
+                    return
+            except NotFoundError:
+                pass
+            if time.monotonic() >= deadline:
+                raise CacheSyncTimeoutError(
+                    f"write to node {name} not visible in cache after "
+                    f"{self._timeout}s"
+                )
+            time.sleep(self._poll)
+
+    def _wait_visible_label(
+        self, name: str, key: str, want: Optional[str]
+    ) -> None:
+        def pred(cached: JsonObj) -> bool:
+            labels = (cached.get("metadata") or {}).get("labels") or {}
+            if want == consts.UPGRADE_STATE_UNKNOWN:
+                return key not in labels
+            return labels.get(key) == want
+
+        self._wait_visible(name, pred)
+
+    def _wait_visible_annotation(
+        self, name: str, key: str, want: Optional[str]
+    ) -> None:
+        def pred(cached: JsonObj) -> bool:
+            anns = (cached.get("metadata") or {}).get("annotations") or {}
+            if want is None:
+                return key not in anns
+            return anns.get(key) == want
+
+        self._wait_visible(name, pred)

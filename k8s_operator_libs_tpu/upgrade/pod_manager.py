@@ -1,0 +1,331 @@
+"""PodManager — workload-pod eviction, driver-pod restart, completion wait.
+
+Reference parity: ``pkg/upgrade/pod_manager.go`` (C5) —
+
+* ``schedule_pod_eviction`` (:122-229): per-node background worker deletes
+  workload pods matching the consumer-supplied ``PodDeletionFilter``
+  through the drain helper; success → ``pod-restart-required``; failure →
+  drain-or-failed fallback (:393-403);
+* ``schedule_pods_restart`` (:233-251): deletes driver pods so the
+  DaemonSet recreates them at the new revision (skips already-terminating
+  pods upstream);
+* ``schedule_check_on_pod_completion`` (:256-317): waits for workload
+  pods to finish; timeout tracked via a start-time node annotation
+  (:331-368);
+* revision-hash oracle (:84-118): pod's ``controller-revision-hash``
+  label vs the DaemonSet's newest ControllerRevision.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from ..api.upgrade_spec import PodDeletionSpec, WaitForCompletionSpec
+from ..cluster.errors import NotFoundError
+from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.objects import (
+    CONTROLLER_REVISION_HASH_LABEL,
+    is_owned_by,
+    name_of,
+    namespace_of,
+    pod_node_name,
+    pod_phase,
+)
+from . import consts, util
+from .drain_manager import DrainHelper, DrainHelperConfig
+from .node_upgrade_state_provider import NodeUpgradeStateProvider
+from .util import EventRecorder, StringSet, log_event
+
+logger = logging.getLogger(__name__)
+
+#: Consumer-supplied predicate choosing which workload pods the upgrade
+#: flow may delete (reference: PodDeletionFilter, pod_manager.go:76).
+PodDeletionFilter = Callable[[JsonObj], bool]
+
+
+class PodManagerError(Exception):
+    pass
+
+
+@dataclass
+class PodManagerConfig:
+    """Reference: PodManagerConfig (pod_manager.go:63-68)."""
+
+    nodes: List[JsonObj] = field(default_factory=list)
+    deletion_spec: Optional[PodDeletionSpec] = None
+    wait_for_completion_spec: Optional[WaitForCompletionSpec] = None
+    drain_enabled: bool = False
+
+
+class PodManager:
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        provider: NodeUpgradeStateProvider,
+        recorder: Optional[EventRecorder] = None,
+        pod_deletion_filter: Optional[PodDeletionFilter] = None,
+    ) -> None:
+        self._cluster = cluster
+        self._provider = provider
+        self._recorder = recorder
+        self._filter = pod_deletion_filter
+        self._nodes_in_progress = StringSet()
+
+    # ---------------------------------------------------- revision-hash oracle
+    def get_pod_controller_revision_hash(self, pod: JsonObj) -> str:
+        """Reference: GetPodControllerRevisionHash (pod_manager.go:84-89)."""
+        labels = (pod.get("metadata") or {}).get("labels") or {}
+        hash_ = labels.get(CONTROLLER_REVISION_HASH_LABEL)
+        if not hash_:
+            raise PodManagerError(
+                f"controller-revision-hash label not present for pod "
+                f"{name_of(pod)}"
+            )
+        return hash_
+
+    def get_daemonset_controller_revision_hash(self, daemonset: JsonObj) -> str:
+        """Newest ControllerRevision owned by the DaemonSet (reference:
+        GetDaemonsetControllerRevisionHash, pod_manager.go:92-119 — sorts by
+        .revision, takes the highest, strips the name prefix)."""
+        ds_name = name_of(daemonset)
+        revisions = [
+            cr
+            for cr in self._cluster.list(
+                "ControllerRevision", namespace=namespace_of(daemonset)
+            )
+            if is_owned_by(cr, daemonset)
+            or name_of(cr).startswith(f"{ds_name}-")
+        ]
+        if not revisions:
+            raise PodManagerError(f"no revision found for daemonset {ds_name}")
+        newest = max(revisions, key=lambda cr: cr.get("revision", 0))
+        cr_name = name_of(newest)
+        prefix = f"{ds_name}-"
+        return cr_name[len(prefix):] if cr_name.startswith(prefix) else cr_name
+
+    # -------------------------------------------------------------- eviction
+    def schedule_pod_eviction(self, config: PodManagerConfig) -> None:
+        """Reference: SchedulePodEviction (pod_manager.go:122-229)."""
+        if config.deletion_spec is None:
+            raise PodManagerError("pod deletion spec should not be empty")
+        if self._filter is None:
+            # The reference makes the filter a mandatory constructor argument
+            # (NewPodManager, pod_manager.go:407-422); without one, eviction
+            # would silently delete nothing and advance nodes over live
+            # workloads.
+            raise PodManagerError(
+                "pod_deletion_filter is required to schedule pod eviction"
+            )
+        for node in config.nodes:
+            name = name_of(node)
+            if not self._nodes_in_progress.add_if_absent(name):
+                logger.debug("pods already being deleted on node %s", name)
+                continue
+            t = threading.Thread(
+                target=self._evict_one,
+                args=(node, config.deletion_spec, config.drain_enabled),
+                daemon=True,
+            )
+            t.start()
+
+    def _evict_one(
+        self, node: JsonObj, spec: PodDeletionSpec, drain_enabled: bool
+    ) -> None:
+        name = name_of(node)
+        try:
+            try:
+                pods_on_node = [
+                    p
+                    for p in self._cluster.list("Pod")
+                    if pod_node_name(p) == name
+                ]
+                to_delete = [
+                    p for p in pods_on_node if self._filter and self._filter(p)
+                ]
+                if not to_delete:
+                    self._change_state(
+                        node, consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+                    )
+                    return
+                # Run the deletion through the drain-helper plan so force/
+                # emptyDir gating applies (reference wires the filter in as
+                # an AdditionalFilter, pod_manager.go:139-158).
+                filt = self._filter
+
+                def additional(pod: JsonObj):
+                    return (bool(filt and filt(pod)), None)
+
+                helper = DrainHelper(
+                    self._cluster,
+                    DrainHelperConfig(
+                        force=spec.force,
+                        delete_empty_dir=spec.delete_empty_dir,
+                        ignore_all_daemon_sets=True,
+                        timeout_seconds=spec.timeout_second,
+                        additional_filters=[additional],
+                    ),
+                )
+                plan, errors = helper.get_pods_for_deletion(name)
+                if len(plan) != len(
+                    [p for p in to_delete if not p["metadata"].get("deletionTimestamp")]
+                ):
+                    raise PodManagerError(
+                        "cannot delete all required pods: " + "; ".join(errors)
+                    )
+                helper.delete_or_evict_pods(plan)
+            except Exception as err:  # noqa: BLE001 — worker boundary
+                logger.error("pod deletion failed on node %s: %s", name, err)
+                log_event(
+                    self._recorder,
+                    name,
+                    "Warning",
+                    util.get_event_reason(),
+                    f"Failed to delete workload pods on the node: {err}",
+                )
+                self._update_node_to_drain_or_failed(node, drain_enabled)
+                return
+            log_event(
+                self._recorder,
+                name,
+                "Normal",
+                util.get_event_reason(),
+                "Deleted workload pods on the node for the upgrade",
+            )
+            self._change_state(node, consts.UPGRADE_STATE_POD_RESTART_REQUIRED)
+        finally:
+            self._nodes_in_progress.remove(name)
+
+    def _update_node_to_drain_or_failed(
+        self, node: JsonObj, drain_enabled: bool
+    ) -> None:
+        """Reference: updateNodeToDrainOrFailed (pod_manager.go:393-403)."""
+        next_state = consts.UPGRADE_STATE_FAILED
+        if drain_enabled:
+            log_event(
+                self._recorder,
+                name_of(node),
+                "Warning",
+                util.get_event_reason(),
+                "Pod deletion failed but drain is enabled in spec. "
+                "Will attempt a node drain",
+            )
+            next_state = consts.UPGRADE_STATE_DRAIN_REQUIRED
+        self._change_state(node, next_state)
+
+    # --------------------------------------------------------------- restart
+    def schedule_pods_restart(self, pods: List[JsonObj]) -> None:
+        """Delete driver pods so their DaemonSet recreates them at the new
+        revision (reference: SchedulePodsRestart, pod_manager.go:233-251 —
+        synchronous; an individual failure aborts with an error)."""
+        for pod in pods:
+            try:
+                self._cluster.delete("Pod", name_of(pod), namespace_of(pod))
+            except NotFoundError:
+                pass
+            except Exception as err:  # noqa: BLE001
+                log_event(
+                    self._recorder,
+                    name_of(pod),
+                    "Warning",
+                    util.get_event_reason(),
+                    f"Failed to restart driver pod {err}",
+                )
+                raise
+
+    # -------------------------------------------------------- completion wait
+    def is_pod_running_or_pending(self, pod: JsonObj) -> bool:
+        """Reference: IsPodRunningOrPending (pod_manager.go:371-391)."""
+        return pod_phase(pod) in ("Running", "Pending")
+
+    def schedule_check_on_pod_completion(self, config: PodManagerConfig) -> None:
+        """Check workload pods matching the wait-for-completion selector on
+        every node; nodes whose pods are all finished move to
+        ``pod-deletion-required``.  Unlike eviction/drain this runs
+        synchronously (the reference waits on its WaitGroup before
+        returning, pod_manager.go:256-317)."""
+        spec = config.wait_for_completion_spec
+        if spec is None:
+            raise PodManagerError("wait-for-completion spec required")
+        for node in config.nodes:
+            name = name_of(node)
+            pods = [
+                p
+                for p in self._cluster.list(
+                    "Pod", label_selector=spec.pod_selector
+                )
+                if pod_node_name(p) == name
+            ]
+            running = any(self.is_pod_running_or_pending(p) for p in pods)
+            if running:
+                if spec.timeout_second != 0:
+                    self._handle_timeout_on_pod_completions(
+                        node, spec.timeout_second
+                    )
+                continue
+            # All finished: clear the start-time annotation and advance.
+            key = util.get_wait_for_pod_completion_start_time_annotation_key()
+            annotations = (node.get("metadata") or {}).get("annotations") or {}
+            if key in annotations:
+                self._provider.change_node_upgrade_annotation(
+                    node, key, consts.NULL_STRING
+                )
+            self._change_state(node, consts.UPGRADE_STATE_POD_DELETION_REQUIRED)
+
+    def _handle_timeout_on_pod_completions(
+        self, node: JsonObj, timeout_seconds: int
+    ) -> None:
+        """Reference: HandleTimeoutOnPodCompletions (pod_manager.go:331-368)."""
+        key = util.get_wait_for_pod_completion_start_time_annotation_key()
+        now = time.time()
+        annotations = (node.get("metadata") or {}).get("annotations") or {}
+        if key not in annotations:
+            self._provider.change_node_upgrade_annotation(
+                node, key, str(int(now))
+            )
+            return
+        try:
+            start = float(annotations[key])
+        except ValueError:
+            # Malformed start-time (external writer): self-heal by restarting
+            # the clock instead of crashing the reconcile loop.
+            logger.error(
+                "malformed completion-wait start time %r on node %s; resetting",
+                annotations[key],
+                name_of(node),
+            )
+            self._provider.change_node_upgrade_annotation(
+                node, key, str(int(now))
+            )
+            return
+        if now > start + timeout_seconds:
+            self._change_state(
+                node, consts.UPGRADE_STATE_POD_DELETION_REQUIRED
+            )
+            self._provider.change_node_upgrade_annotation(
+                node, key, consts.NULL_STRING
+            )
+
+    # ------------------------------------------------------------- internals
+    @property
+    def nodes_in_progress(self) -> StringSet:
+        return self._nodes_in_progress
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while len(self._nodes_in_progress) > 0:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+        return True
+
+    def _change_state(self, node: JsonObj, state: str) -> None:
+        try:
+            self._provider.change_node_upgrade_state(node, state)
+        except Exception as err:  # noqa: BLE001
+            logger.error(
+                "failed to change state of node %s: %s", name_of(node), err
+            )

@@ -1,0 +1,123 @@
+"""ValidationManager — post-upgrade validation gate.
+
+Reference parity: ``pkg/upgrade/validation_manager.go`` (C8) — waits for
+consumer-designated validation pods (label selector) on the node to be
+Running with all containers Ready; a 600 s timeout (:31-33) is tracked via
+a start-time node annotation, and on expiry the node is moved to
+``upgrade-failed`` (:139-175).  An empty selector validates trivially.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.objects import name_of, pod_node_name, pod_phase
+from . import consts, util
+from .node_upgrade_state_provider import NodeUpgradeStateProvider
+from .util import EventRecorder, log_event
+
+logger = logging.getLogger(__name__)
+
+#: Reference: validationTimeoutSeconds = 600 (validation_manager.go:31-33).
+DEFAULT_VALIDATION_TIMEOUT_SECONDS = 600
+
+
+class ValidationManager:
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        provider: NodeUpgradeStateProvider,
+        recorder: Optional[EventRecorder] = None,
+        pod_selector: str = "",
+        timeout_seconds: int = DEFAULT_VALIDATION_TIMEOUT_SECONDS,
+    ) -> None:
+        self._cluster = cluster
+        self._provider = provider
+        self._recorder = recorder
+        self.pod_selector = pod_selector
+        self._timeout = timeout_seconds
+
+    def validate(self, node: JsonObj) -> bool:
+        """True when validation is complete on *node* (reference: Validate,
+        validation_manager.go:71-116)."""
+        if not self.pod_selector:
+            return True
+        name = name_of(node)
+        pods = [
+            p
+            for p in self._cluster.list("Pod", label_selector=self.pod_selector)
+            if pod_node_name(p) == name
+        ]
+        if not pods:
+            logger.warning(
+                "no validation pods found on node %s (selector %r)",
+                name,
+                self.pod_selector,
+            )
+            # Missing pods also run against the timeout clock — otherwise a
+            # node whose validation pod never schedules would wait forever.
+            self._handle_timeout(node)
+            return False
+        for pod in pods:
+            if not self._is_pod_ready(pod):
+                self._handle_timeout(node)
+                return False
+        # Validation passed: clear the start-time annotation.
+        key = util.get_validation_start_time_annotation_key()
+        annotations = (node.get("metadata") or {}).get("annotations") or {}
+        if key in annotations:
+            self._provider.change_node_upgrade_annotation(
+                node, key, consts.NULL_STRING
+            )
+        return True
+
+    @staticmethod
+    def _is_pod_ready(pod: JsonObj) -> bool:
+        """Running + at least one container + all containers Ready
+        (reference: isPodReady, validation_manager.go:118-136)."""
+        if pod_phase(pod) != "Running":
+            return False
+        statuses = (pod.get("status") or {}).get("containerStatuses") or []
+        if not statuses:
+            return False
+        return all(s.get("ready", False) for s in statuses)
+
+    def _handle_timeout(self, node: JsonObj) -> None:
+        """Reference: handleTimeout (validation_manager.go:139-175)."""
+        key = util.get_validation_start_time_annotation_key()
+        now = time.time()
+        annotations = (node.get("metadata") or {}).get("annotations") or {}
+        if key not in annotations:
+            self._provider.change_node_upgrade_annotation(
+                node, key, str(int(now))
+            )
+            return
+        try:
+            start = float(annotations[key])
+        except ValueError:
+            logger.error(
+                "malformed validation start time %r on node %s; resetting",
+                annotations[key],
+                name_of(node),
+            )
+            self._provider.change_node_upgrade_annotation(
+                node, key, str(int(now))
+            )
+            return
+        if now > start + self._timeout:
+            log_event(
+                self._recorder,
+                name_of(node),
+                "Warning",
+                util.get_event_reason(),
+                "Validation timed out; marking node upgrade-failed",
+            )
+            self._provider.change_node_upgrade_state(
+                node, consts.UPGRADE_STATE_FAILED
+            )
+            self._provider.change_node_upgrade_annotation(
+                node, key, consts.NULL_STRING
+            )

@@ -130,6 +130,18 @@ class TestMergePatch:
                 {"metadata": {"resourceVersion": stale_rv, "labels": {"b": "2"}}},
             )
 
+    def test_finalizer_clear_via_patch_removes_terminating_object(self, cluster):
+        from k8s_operator_libs_tpu.cluster.objects import make_pod
+
+        pod = make_pod("p1", "ns", "n1")
+        pod["metadata"]["finalizers"] = ["example.com/fin"]
+        cluster.create(pod)
+        cluster.delete("Pod", "p1", "ns")  # marks terminating
+        assert cluster.get("Pod", "p1", "ns")["metadata"]["deletionTimestamp"]
+        cluster.patch("Pod", "p1", {"metadata": {"finalizers": None}}, "ns")
+        with pytest.raises(NotFoundError):
+            cluster.get("Pod", "p1", "ns")
+
     def test_patch_without_rv_is_last_write_wins(self, cluster):
         cluster.create(make_node("n1"))
         cluster.patch("Node", "n1", {"metadata": {"labels": {"a": "1"}}})

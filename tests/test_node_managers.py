@@ -1,0 +1,519 @@
+"""L2 node-op manager tests against the in-memory apiserver.
+
+Reference spec coverage: cordon_manager_test.go (39), drain_manager_test.go
+(162), pod_manager_test.go (452), validation_manager_test.go (172),
+safe_driver_load_manager_test.go (71), node_upgrade_state_provider_test.go
+(70) — eviction force/emptyDir matrix, completion-wait timeouts, drain
+success/failure transitions, cache-visibility wait.
+"""
+
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DrainSpec, PodDeletionSpec, WaitForCompletionSpec
+from k8s_operator_libs_tpu.cluster import InformerCache, InMemoryCluster
+from k8s_operator_libs_tpu.cluster.objects import (
+    get_annotation,
+    get_label,
+    make_controller_revision,
+    make_daemonset,
+    make_node,
+    make_pod,
+)
+from k8s_operator_libs_tpu.upgrade import consts, util
+from k8s_operator_libs_tpu.upgrade.cordon_manager import CordonManager
+from k8s_operator_libs_tpu.upgrade.drain_manager import (
+    DrainConfiguration,
+    DrainError,
+    DrainHelper,
+    DrainHelperConfig,
+    DrainManager,
+)
+from k8s_operator_libs_tpu.upgrade.node_upgrade_state_provider import (
+    CacheSyncTimeoutError,
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.pod_manager import (
+    PodManager,
+    PodManagerConfig,
+    PodManagerError,
+)
+from k8s_operator_libs_tpu.upgrade.safe_driver_load_manager import (
+    SafeDriverLoadManager,
+)
+from k8s_operator_libs_tpu.upgrade.validation_manager import ValidationManager
+
+
+@pytest.fixture()
+def provider(cluster, cache, recorder):
+    return NodeUpgradeStateProvider(
+        cluster,
+        cache,
+        recorder,
+        cache_sync_timeout_seconds=2.0,
+        cache_sync_poll_seconds=0.01,
+    )
+
+
+def state_of(cluster, node_name):
+    return get_label(
+        cluster.get("Node", node_name), util.get_upgrade_state_label_key()
+    )
+
+
+class TestNodeUpgradeStateProvider:
+    def test_change_state_visible_and_in_place(self, cluster, provider):
+        node = cluster.create(make_node("n1"))
+        provider.change_node_upgrade_state(
+            node, consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        )
+        assert state_of(cluster, "n1") == "upgrade-required"
+        # caller's copy updated in place (reference mutates the shared node)
+        assert (
+            node["metadata"]["labels"][util.get_upgrade_state_label_key()]
+            == "upgrade-required"
+        )
+
+    def test_change_state_to_unknown_removes_label(self, cluster, provider):
+        node = cluster.create(make_node("n1"))
+        provider.change_node_upgrade_state(node, consts.UPGRADE_STATE_DONE)
+        provider.change_node_upgrade_state(node, consts.UPGRADE_STATE_UNKNOWN)
+        assert util.get_upgrade_state_label_key() not in (
+            cluster.get("Node", "n1")["metadata"].get("labels") or {}
+        )
+
+    def test_annotation_set_and_null_delete(self, cluster, provider):
+        node = cluster.create(make_node("n1"))
+        key = util.get_upgrade_requested_annotation_key()
+        provider.change_node_upgrade_annotation(node, key, "true")
+        assert get_annotation(cluster.get("Node", "n1"), key) == "true"
+        provider.change_node_upgrade_annotation(node, key, consts.NULL_STRING)
+        assert key not in cluster.get("Node", "n1")["metadata"]["annotations"]
+
+    def test_waits_for_lagged_cache(self, cluster, recorder):
+        cache = InformerCache(cluster, lag_seconds=0.1)
+        provider = NodeUpgradeStateProvider(
+            cluster,
+            cache,
+            recorder,
+            cache_sync_timeout_seconds=3.0,
+            cache_sync_poll_seconds=0.02,
+        )
+        node = cluster.create(make_node("n1"))
+        cache.sync()
+        t0 = time.monotonic()
+        provider.change_node_upgrade_state(node, consts.UPGRADE_STATE_DONE)
+        # write had to wait for at least one cache refresh cycle
+        assert cache.get("Node", "n1")["metadata"]["labels"]
+        assert time.monotonic() - t0 < 3.0
+
+    def test_timeout_when_cache_never_syncs(self, cluster, recorder):
+        cache = InformerCache(cluster, lag_seconds=9999)
+        provider = NodeUpgradeStateProvider(
+            cluster,
+            cache,
+            recorder,
+            cache_sync_timeout_seconds=0.1,
+            cache_sync_poll_seconds=0.02,
+        )
+        node = cluster.create(make_node("n1"))
+        with pytest.raises(CacheSyncTimeoutError):
+            provider.change_node_upgrade_state(node, consts.UPGRADE_STATE_DONE)
+
+    def test_emits_event(self, cluster, provider, recorder):
+        node = cluster.create(make_node("n1"))
+        provider.change_node_upgrade_state(node, consts.UPGRADE_STATE_DONE)
+        assert any("upgrade-done" in m for m in recorder.messages())
+
+
+class TestCordonManager:
+    def test_cordon_uncordon(self, cluster, recorder):
+        mgr = CordonManager(cluster, recorder)
+        node = cluster.create(make_node("n1"))
+        mgr.cordon(node)
+        assert cluster.get("Node", "n1")["spec"]["unschedulable"] is True
+        mgr.uncordon(node)
+        assert cluster.get("Node", "n1")["spec"]["unschedulable"] is False
+
+    def test_noop_when_already_desired(self, cluster, recorder):
+        mgr = CordonManager(cluster, recorder)
+        node = cluster.create(make_node("n1", unschedulable=True))
+        rv = cluster.get("Node", "n1")["metadata"]["resourceVersion"]
+        mgr.cordon(node)
+        assert cluster.get("Node", "n1")["metadata"]["resourceVersion"] == rv
+
+
+class TestDrainHelper:
+    def _cluster_with_pods(self):
+        cluster = InMemoryCluster()
+        cluster.create(make_node("n1"))
+        ds = cluster.create(make_daemonset("driver", "ops", {"app": "driver"}))
+        rs = {"kind": "ReplicaSet", "metadata": {"name": "rs1", "namespace": "apps"}}
+        cluster.create(make_pod("driver-pod", "ops", "n1", owner=ds))
+        cluster.create(make_pod("app-pod", "apps", "n1", owner=rs))
+        cluster.create(make_pod("bare-pod", "apps", "n1"))
+        cluster.create(
+            make_pod("scratch-pod", "apps", "n1", owner=rs, empty_dir=True)
+        )
+        return cluster
+
+    def test_daemonset_pods_ignored(self):
+        cluster = self._cluster_with_pods()
+        helper = DrainHelper(
+            cluster, DrainHelperConfig(force=True, delete_empty_dir=True)
+        )
+        pods, errors = helper.get_pods_for_deletion("n1")
+        assert errors == []
+        assert "driver-pod" not in [p["metadata"]["name"] for p in pods]
+
+    def test_bare_pod_requires_force(self):
+        cluster = self._cluster_with_pods()
+        helper = DrainHelper(cluster, DrainHelperConfig(delete_empty_dir=True))
+        _pods, errors = helper.get_pods_for_deletion("n1")
+        assert any("without force" in e for e in errors)
+
+    def test_empty_dir_requires_flag(self):
+        cluster = self._cluster_with_pods()
+        helper = DrainHelper(cluster, DrainHelperConfig(force=True))
+        _pods, errors = helper.get_pods_for_deletion("n1")
+        assert any("emptyDir" in e for e in errors)
+
+    def test_finished_bare_pod_deletable_without_force(self):
+        cluster = InMemoryCluster()
+        cluster.create(make_node("n1"))
+        cluster.create(make_pod("done-pod", "apps", "n1", phase="Succeeded"))
+        helper = DrainHelper(cluster, DrainHelperConfig())
+        pods, errors = helper.get_pods_for_deletion("n1")
+        assert errors == [] and [p["metadata"]["name"] for p in pods] == ["done-pod"]
+
+    def test_pod_selector_filters(self):
+        cluster = self._cluster_with_pods()
+        helper = DrainHelper(
+            cluster,
+            DrainHelperConfig(
+                force=True, delete_empty_dir=True, pod_selector="!nothing-has-this"
+            ),
+        )
+        pods, _ = helper.get_pods_for_deletion("n1")
+        assert len(pods) == 3
+
+    def test_delete_waits_and_times_out_on_finalizer(self):
+        cluster = InMemoryCluster()
+        cluster.create(make_node("n1"))
+        pod = make_pod("stuck", "apps", "n1", phase="Succeeded")
+        pod["metadata"]["finalizers"] = ["example.com/stuck"]
+        cluster.create(pod)
+        helper = DrainHelper(cluster, DrainHelperConfig(timeout_seconds=1))
+        pods, _ = helper.get_pods_for_deletion("n1")
+        with pytest.raises(DrainError, match="timed out"):
+            helper.delete_or_evict_pods(pods)
+
+
+class TestDrainManager:
+    def test_successful_drain_transitions_to_pod_restart(
+        self, cluster, provider, recorder
+    ):
+        node = cluster.create(make_node("n1"))
+        rs = {"kind": "ReplicaSet", "metadata": {"name": "rs1", "namespace": "apps"}}
+        cluster.create(make_pod("app-pod", "apps", "n1", owner=rs))
+        mgr = DrainManager(cluster, provider, recorder)
+        mgr.schedule_nodes_drain(
+            DrainConfiguration(spec=DrainSpec(enable=True), nodes=[node])
+        )
+        assert mgr.wait_idle(5.0)
+        assert cluster.get("Node", "n1")["spec"]["unschedulable"] is True
+        assert state_of(cluster, "n1") == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+        assert not cluster.list("Pod", namespace="apps")
+
+    def test_failed_drain_transitions_to_failed(self, cluster, provider, recorder):
+        node = cluster.create(make_node("n1"))
+        cluster.create(make_pod("bare-pod", "apps", "n1"))  # needs force
+        mgr = DrainManager(cluster, provider, recorder)
+        mgr.schedule_nodes_drain(
+            DrainConfiguration(spec=DrainSpec(enable=True, force=False), nodes=[node])
+        )
+        assert mgr.wait_idle(5.0)
+        assert state_of(cluster, "n1") == consts.UPGRADE_STATE_FAILED
+        assert any("Failed to drain" in m for m in recorder.messages())
+
+    def test_drain_dedup_in_flight(self, cluster, provider, recorder):
+        node = cluster.create(make_node("n1"))
+        pod = make_pod("stuck", "apps", "n1", phase="Succeeded")
+        pod["metadata"]["finalizers"] = ["example.com/slow"]
+        cluster.create(pod)
+        mgr = DrainManager(cluster, provider, recorder)
+        spec = DrainSpec(enable=True, timeout_second=2)
+        mgr.schedule_nodes_drain(DrainConfiguration(spec=spec, nodes=[node]))
+        time.sleep(0.05)
+        assert mgr.in_flight.has("n1")
+        # second schedule while in flight must not spawn a second worker
+        mgr.schedule_nodes_drain(DrainConfiguration(spec=spec, nodes=[node]))
+        # release the stuck pod so the drain finishes
+        stuck = cluster.get("Pod", "stuck", "apps")
+        stuck["metadata"]["finalizers"] = []
+        cluster.update(stuck)
+        assert mgr.wait_idle(5.0)
+        assert state_of(cluster, "n1") == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+
+    def test_disabled_spec_rejected(self, cluster, provider, recorder):
+        mgr = DrainManager(cluster, provider, recorder)
+        with pytest.raises(DrainError):
+            mgr.schedule_nodes_drain(
+                DrainConfiguration(spec=DrainSpec(enable=False), nodes=[])
+            )
+
+
+class TestPodManagerRevisionHash:
+    def test_daemonset_hash_is_newest_revision(self, cluster, provider):
+        ds = cluster.create(make_daemonset("driver", "ops"))
+        cluster.create(make_controller_revision(ds, 1, "aaa"))
+        cluster.create(make_controller_revision(ds, 3, "ccc"))
+        cluster.create(make_controller_revision(ds, 2, "bbb"))
+        mgr = PodManager(cluster, provider)
+        assert mgr.get_daemonset_controller_revision_hash(ds) == "ccc"
+
+    def test_no_revisions_is_error(self, cluster, provider):
+        ds = cluster.create(make_daemonset("driver", "ops"))
+        mgr = PodManager(cluster, provider)
+        with pytest.raises(PodManagerError, match="no revision"):
+            mgr.get_daemonset_controller_revision_hash(ds)
+
+    def test_pod_hash_label_required(self, cluster, provider):
+        mgr = PodManager(cluster, provider)
+        pod = make_pod("p", "ops", "n1", revision_hash="abc")
+        assert mgr.get_pod_controller_revision_hash(pod) == "abc"
+        with pytest.raises(PodManagerError):
+            mgr.get_pod_controller_revision_hash(make_pod("q", "ops", "n1"))
+
+
+class TestPodEviction:
+    def _setup(self, cluster, provider, *, force=True, empty_dir=False,
+               drain_enabled=False, filter=None):
+        node = cluster.create(make_node("n1"))
+        rs = {"kind": "ReplicaSet", "metadata": {"name": "rs1", "namespace": "apps"}}
+        cluster.create(
+            make_pod(
+                "workload", "apps", "n1", labels={"app": "workload"},
+                owner=rs, empty_dir=empty_dir,
+            )
+        )
+        cluster.create(make_pod("other", "apps", "n1", labels={"app": "other"}, owner=rs))
+        mgr = PodManager(
+            cluster,
+            provider,
+            pod_deletion_filter=filter
+            or (lambda pod: get_label(pod, "app") == "workload"),
+        )
+        config = PodManagerConfig(
+            nodes=[node],
+            deletion_spec=PodDeletionSpec(
+                force=force, delete_empty_dir=empty_dir, timeout_second=5
+            ),
+            drain_enabled=drain_enabled,
+        )
+        return node, mgr, config
+
+    def test_filtered_eviction_deletes_only_matching(self, cluster, provider):
+        node, mgr, config = self._setup(cluster, provider)
+        mgr.schedule_pod_eviction(config)
+        assert mgr.wait_idle(5.0)
+        names = [p["metadata"]["name"] for p in cluster.list("Pod")]
+        assert names == ["other"]
+        assert state_of(cluster, "n1") == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+
+    def test_no_matching_pods_advances_state(self, cluster, provider):
+        node, mgr, config = self._setup(
+            cluster, provider, filter=lambda pod: False
+        )
+        mgr.schedule_pod_eviction(config)
+        assert mgr.wait_idle(5.0)
+        assert len(cluster.list("Pod")) == 2
+        assert state_of(cluster, "n1") == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+
+    def test_empty_dir_violation_fails_node(self, cluster, provider):
+        node, mgr, config = self._setup(cluster, provider, empty_dir=True)
+        config.deletion_spec.delete_empty_dir = False
+        mgr.schedule_pod_eviction(config)
+        assert mgr.wait_idle(5.0)
+        assert state_of(cluster, "n1") == consts.UPGRADE_STATE_FAILED
+
+    def test_empty_dir_violation_with_drain_enabled_falls_back(
+        self, cluster, provider
+    ):
+        node, mgr, config = self._setup(
+            cluster, provider, empty_dir=True, drain_enabled=True
+        )
+        config.deletion_spec.delete_empty_dir = False
+        mgr.schedule_pod_eviction(config)
+        assert mgr.wait_idle(5.0)
+        assert state_of(cluster, "n1") == consts.UPGRADE_STATE_DRAIN_REQUIRED
+
+    def test_missing_deletion_spec_rejected(self, cluster, provider):
+        mgr = PodManager(cluster, provider)
+        with pytest.raises(PodManagerError):
+            mgr.schedule_pod_eviction(PodManagerConfig(nodes=[]))
+
+    def test_missing_filter_rejected(self, cluster, provider):
+        # Reference makes the filter mandatory (NewPodManager,
+        # pod_manager.go:407-422); eviction without one must not silently
+        # advance nodes over live workloads.
+        mgr = PodManager(cluster, provider, pod_deletion_filter=None)
+        with pytest.raises(PodManagerError, match="filter"):
+            mgr.schedule_pod_eviction(
+                PodManagerConfig(nodes=[], deletion_spec=PodDeletionSpec())
+            )
+
+    def test_malformed_start_time_annotation_self_heals(
+        self, cluster, provider
+    ):
+        node = cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod("job", "apps", "n1", labels={"app": "job"}, phase="Running")
+        )
+        key = util.get_wait_for_pod_completion_start_time_annotation_key()
+        provider.change_node_upgrade_annotation(node, key, "garbage")
+        mgr = PodManager(cluster, provider)
+        mgr.schedule_check_on_pod_completion(
+            PodManagerConfig(
+                nodes=[node],
+                wait_for_completion_spec=WaitForCompletionSpec(
+                    pod_selector="app=job", timeout_second=30
+                ),
+            )
+        )
+        # annotation rewritten with a numeric clock value, no crash
+        float(get_annotation(cluster.get("Node", "n1"), key))
+
+
+class TestPodRestart:
+    def test_restart_deletes_driver_pods(self, cluster, provider):
+        ds = cluster.create(make_daemonset("driver", "ops"))
+        p1 = cluster.create(make_pod("driver-a", "ops", "n1", owner=ds))
+        cluster.create(make_pod("driver-b", "ops", "n2", owner=ds))
+        mgr = PodManager(cluster, provider)
+        mgr.schedule_pods_restart([p1])
+        names = [p["metadata"]["name"] for p in cluster.list("Pod")]
+        assert names == ["driver-b"]
+
+
+class TestPodCompletionWait:
+    def test_all_finished_advances(self, cluster, provider):
+        node = cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod("job", "apps", "n1", labels={"app": "job"}, phase="Succeeded")
+        )
+        mgr = PodManager(cluster, provider)
+        mgr.schedule_check_on_pod_completion(
+            PodManagerConfig(
+                nodes=[node],
+                wait_for_completion_spec=WaitForCompletionSpec(
+                    pod_selector="app=job"
+                ),
+            )
+        )
+        assert state_of(cluster, "n1") == consts.UPGRADE_STATE_POD_DELETION_REQUIRED
+
+    def test_running_pods_block_without_timeout(self, cluster, provider):
+        node = cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod("job", "apps", "n1", labels={"app": "job"}, phase="Running")
+        )
+        mgr = PodManager(cluster, provider)
+        mgr.schedule_check_on_pod_completion(
+            PodManagerConfig(
+                nodes=[node],
+                wait_for_completion_spec=WaitForCompletionSpec(
+                    pod_selector="app=job", timeout_second=0
+                ),
+            )
+        )
+        assert state_of(cluster, "n1") == ""  # unchanged
+
+    def test_timeout_annotation_then_expiry_advances(self, cluster, provider):
+        node = cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod("job", "apps", "n1", labels={"app": "job"}, phase="Running")
+        )
+        mgr = PodManager(cluster, provider)
+        config = PodManagerConfig(
+            nodes=[node],
+            wait_for_completion_spec=WaitForCompletionSpec(
+                pod_selector="app=job", timeout_second=1
+            ),
+        )
+        mgr.schedule_check_on_pod_completion(config)
+        key = util.get_wait_for_pod_completion_start_time_annotation_key()
+        assert get_annotation(cluster.get("Node", "n1"), key) != ""
+        # force expiry by back-dating the annotation
+        provider.change_node_upgrade_annotation(
+            node, key, str(int(time.time()) - 10)
+        )
+        mgr.schedule_check_on_pod_completion(config)
+        assert state_of(cluster, "n1") == consts.UPGRADE_STATE_POD_DELETION_REQUIRED
+        assert key not in cluster.get("Node", "n1")["metadata"]["annotations"]
+
+
+class TestValidationManager:
+    def test_empty_selector_validates(self, cluster, provider):
+        node = cluster.create(make_node("n1"))
+        mgr = ValidationManager(cluster, provider, pod_selector="")
+        assert mgr.validate(node) is True
+
+    def test_ready_pod_validates_and_clears_annotation(self, cluster, provider):
+        node = cluster.create(make_node("n1"))
+        key = util.get_validation_start_time_annotation_key()
+        provider.change_node_upgrade_annotation(node, key, "123")
+        pod = make_pod("val", "ops", "n1", labels={"app": "validator"})
+        pod["status"]["containerStatuses"] = [{"name": "c", "ready": True}]
+        cluster.create(pod)
+        mgr = ValidationManager(cluster, provider, pod_selector="app=validator")
+        assert mgr.validate(node) is True
+        assert key not in cluster.get("Node", "n1")["metadata"]["annotations"]
+
+    def test_not_ready_starts_clock_then_times_out_to_failed(
+        self, cluster, provider
+    ):
+        node = cluster.create(make_node("n1"))
+        pod = make_pod("val", "ops", "n1", labels={"app": "validator"})
+        pod["status"]["containerStatuses"] = [{"name": "c", "ready": False}]
+        cluster.create(pod)
+        mgr = ValidationManager(
+            cluster, provider, pod_selector="app=validator", timeout_seconds=1
+        )
+        assert mgr.validate(node) is False
+        key = util.get_validation_start_time_annotation_key()
+        assert get_annotation(cluster.get("Node", "n1"), key) != ""
+        provider.change_node_upgrade_annotation(
+            node, key, str(int(time.time()) - 10)
+        )
+        assert mgr.validate(node) is False
+        assert state_of(cluster, "n1") == consts.UPGRADE_STATE_FAILED
+
+    def test_missing_validation_pod_counts_against_timeout(
+        self, cluster, provider
+    ):
+        node = cluster.create(make_node("n1"))
+        mgr = ValidationManager(
+            cluster, provider, pod_selector="app=validator", timeout_seconds=1
+        )
+        assert mgr.validate(node) is False
+        key = util.get_validation_start_time_annotation_key()
+        assert get_annotation(cluster.get("Node", "n1"), key) != ""
+
+
+class TestSafeDriverLoadManager:
+    def test_detect_and_unblock(self, cluster, provider):
+        key = util.get_wait_for_safe_load_annotation_key()
+        node = cluster.create(make_node("n1", annotations={key: "driver-pod-x"}))
+        mgr = SafeDriverLoadManager(provider)
+        assert mgr.is_waiting_for_safe_driver_load(node) is True
+        mgr.unblock_loading(node)
+        assert key not in cluster.get("Node", "n1")["metadata"]["annotations"]
+        assert mgr.is_waiting_for_safe_driver_load(node) is False
+
+    def test_unblock_noop_when_absent(self, cluster, provider):
+        node = cluster.create(make_node("n1"))
+        rv = cluster.get("Node", "n1")["metadata"]["resourceVersion"]
+        SafeDriverLoadManager(provider).unblock_loading(node)
+        assert cluster.get("Node", "n1")["metadata"]["resourceVersion"] == rv
