@@ -1,0 +1,89 @@
+"""Slice topology — atomic unavailability domains for TPU fleets.
+
+The reference throttle (C15) counts *nodes*: ``maxUnavailable`` caps how
+many nodes may be cordoned/not-ready at once (common_manager.go:748-776).
+On a multi-host TPU slice that unit is wrong: the hosts of a slice are
+ICI-coupled into one SPMD failure domain — draining *one* host kills the
+workload on *every* host of the slice.  This module supplies the
+domain-level accounting the slice-aware throttle uses instead
+(SURVEY.md §7 step 4, hard part #1):
+
+* a node's **domain** is its slice id (from ``SLICE_ID_LABEL_KEYS``, e.g.
+  ``tpu.google.com/slice-id`` or the GKE TPU topology labels), or a
+  singleton domain for nodes without slice labels;
+* a domain is *unavailable* if **any** of its nodes is cordoned or
+  not-ready (the slice can't run SPMD work at partial strength);
+* a domain is *in progress* if any of its nodes is in an active upgrade
+  state;
+* the throttle resolves ``maxUnavailable`` percentages against the domain
+  count and spends one slot per **domain**, and the in-place scheduler
+  co-schedules all of a domain's nodes together — the slice is down once,
+  not N times.
+
+Everything here is pure functions over node dicts; the policy switch is
+:attr:`~..api.upgrade_spec.UpgradePolicySpec.slice_aware`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from ..cluster.inmem import JsonObj
+from ..cluster.objects import (
+    name_of,
+    node_is_ready,
+    node_is_unschedulable,
+)
+from ..upgrade import consts
+
+#: Prefix for the singleton domain of a node with no slice label.
+_SINGLETON_PREFIX = "node:"
+
+
+def slice_id_of(node: JsonObj) -> Optional[str]:
+    """The node's slice identity, or None if it carries no slice label."""
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    for key in consts.SLICE_ID_LABEL_KEYS:
+        value = labels.get(key)
+        if value:
+            return value
+    return None
+
+
+def domain_of(node: JsonObj) -> str:
+    """The node's atomic unavailability domain (slice id or itself)."""
+    sid = slice_id_of(node)
+    if sid is not None:
+        return sid
+    return _SINGLETON_PREFIX + name_of(node)
+
+
+def is_singleton_domain(domain: str) -> bool:
+    return domain.startswith(_SINGLETON_PREFIX)
+
+
+def group_by_domain(nodes: Iterable[JsonObj]) -> Dict[str, List[JsonObj]]:
+    """Bucket nodes into their domains (stable within input order)."""
+    out: Dict[str, List[JsonObj]] = {}
+    for node in nodes:
+        out.setdefault(domain_of(node), []).append(node)
+    return out
+
+
+def node_is_unavailable(node: JsonObj) -> bool:
+    """Reference unavailability test: cordoned or not-ready
+    (common_manager.go:146-165)."""
+    return node_is_unschedulable(node) or not node_is_ready(node)
+
+
+def count_unavailable_domains(nodes: Iterable[JsonObj]) -> int:
+    """Domains with at least one unavailable node."""
+    unavailable = set()
+    for node in nodes:
+        if node_is_unavailable(node):
+            unavailable.add(domain_of(node))
+    return len(unavailable)
+
+
+def count_domains(nodes: Iterable[JsonObj]) -> int:
+    return len({domain_of(n) for n in nodes})

@@ -1,5 +1,55 @@
 """The rolling-upgrade state machine (reference: pkg/upgrade)."""
 
 from . import consts, util
+from .common_manager import (
+    ClusterUpgradeState,
+    CommonUpgradeManager,
+    NodeUpgradeState,
+)
+from .cordon_manager import CordonManager
+from .drain_manager import (
+    DrainConfiguration,
+    DrainError,
+    DrainHelper,
+    DrainHelperConfig,
+    DrainManager,
+)
+from .node_upgrade_state_provider import (
+    CacheSyncTimeoutError,
+    NodeUpgradeStateProvider,
+)
+from .pod_manager import (
+    PodDeletionFilter,
+    PodManager,
+    PodManagerConfig,
+    PodManagerError,
+)
+from .safe_driver_load_manager import SafeDriverLoadManager
+from .upgrade_inplace import InplaceNodeStateManager
+from .upgrade_state import ClusterUpgradeStateManager, UpgradeStateError
+from .validation_manager import ValidationManager
 
-__all__ = ["consts", "util"]
+__all__ = [
+    "consts",
+    "util",
+    "ClusterUpgradeState",
+    "CommonUpgradeManager",
+    "NodeUpgradeState",
+    "CordonManager",
+    "DrainConfiguration",
+    "DrainError",
+    "DrainHelper",
+    "DrainHelperConfig",
+    "DrainManager",
+    "CacheSyncTimeoutError",
+    "NodeUpgradeStateProvider",
+    "PodDeletionFilter",
+    "PodManager",
+    "PodManagerConfig",
+    "PodManagerError",
+    "SafeDriverLoadManager",
+    "InplaceNodeStateManager",
+    "ClusterUpgradeStateManager",
+    "UpgradeStateError",
+    "ValidationManager",
+]

@@ -1,0 +1,524 @@
+"""CommonUpgradeManager — the per-state processors shared by both modes.
+
+Reference parity: ``pkg/upgrade/common_manager.go`` (C2) —
+
+* :class:`NodeUpgradeState` / :class:`ClusterUpgradeState` (:56-75);
+* done/unknown classification: revision-hash sync + safe-load +
+  upgrade-requested annotation (:229-291), initial-unschedulable capture
+  (:250-264);
+* pod↔DaemonSet revision sync oracle (:299-320);
+* cordon / wait-for-jobs / pod-deletion / drain scheduling processors
+  (:361-453);
+* pod-restart with failure detection — a driver container not-Ready with
+  restartCount > 10 fails the node (:457-524, 636-648);
+* failed-node self-healing once the pod is back in sync (:528-570);
+* validation processor (:573-604);
+* uncordon-or-done with the initial-unschedulable skip (:673-708);
+* census + upgrade-slot math (:712-776).
+
+TPU-native: when the policy sets ``slice_aware``, the census and slot
+math run in **slice domains** (see :mod:`..tpu.topology`) instead of raw
+nodes — one multi-host slice counts once toward ``maxUnavailable``.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api.upgrade_spec import (
+    DrainSpec,
+    PodDeletionSpec,
+    UpgradePolicySpec,
+    WaitForCompletionSpec,
+)
+from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.objects import (
+    is_owned_by,
+    name_of,
+    node_is_ready,
+    node_is_unschedulable,
+    owner_references,
+    pod_phase,
+)
+from ..tpu import topology
+from . import consts, util
+from .cordon_manager import CordonManager
+from .drain_manager import DrainConfiguration, DrainManager
+from .node_upgrade_state_provider import NodeUpgradeStateProvider
+from .pod_manager import PodManager, PodManagerConfig, PodManagerError
+from .safe_driver_load_manager import SafeDriverLoadManager
+from .util import EventRecorder, log_event
+from .validation_manager import ValidationManager
+
+logger = logging.getLogger(__name__)
+
+#: Reference: a driver container not Ready with > 10 restarts fails the node
+#: (common_manager.go:636-648).
+POD_RESTART_FAILURE_THRESHOLD = 10
+
+
+@dataclass
+class NodeUpgradeState:
+    """One node + its driver pod + owning DaemonSet (reference :56-66)."""
+
+    node: JsonObj
+    driver_pod: JsonObj
+    driver_daemonset: Optional[JsonObj] = None
+
+    def is_orphaned_pod(self) -> bool:
+        """Reference: IsOrphanedPod — no owner references (:221-223)."""
+        return self.driver_daemonset is None
+
+
+@dataclass
+class ClusterUpgradeState:
+    """Point-in-time snapshot: state-label → node states (reference :69-75)."""
+
+    node_states: Dict[str, List[NodeUpgradeState]] = field(default_factory=dict)
+
+    def nodes_in(self, state: str) -> List[NodeUpgradeState]:
+        return self.node_states.get(state, [])
+
+    def all_node_states(self) -> List[NodeUpgradeState]:
+        return [ns for states in self.node_states.values() for ns in states]
+
+    def managed_node_states(self) -> List[NodeUpgradeState]:
+        """Node states in *recognized* buckets only.  A node whose state
+        label was corrupted to an unknown value is excluded from census
+        math so it cannot permanently consume throttle slots (the
+        reference's GetTotalManagedNodes likewise sums only known buckets,
+        common_manager.go:712-728; unlike the reference we also count the
+        two maintenance states so requestor-delegated nodes hold slots)."""
+        return [
+            ns
+            for state, nss in self.node_states.items()
+            if state in consts.ALL_STATES
+            for ns in nss
+        ]
+
+
+class CommonUpgradeManager:
+    """Shared state-processing logic used by both mode strategies."""
+
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        provider: NodeUpgradeStateProvider,
+        cordon_manager: CordonManager,
+        drain_manager: DrainManager,
+        pod_manager: PodManager,
+        validation_manager: ValidationManager,
+        safe_driver_load_manager: SafeDriverLoadManager,
+        recorder: Optional[EventRecorder] = None,
+        pod_deletion_enabled: bool = False,
+        validation_enabled: bool = False,
+    ) -> None:
+        self._cluster = cluster
+        self.provider = provider
+        self.cordon_manager = cordon_manager
+        self.drain_manager = drain_manager
+        self.pod_manager = pod_manager
+        self.validation_manager = validation_manager
+        self.safe_driver_load_manager = safe_driver_load_manager
+        self.recorder = recorder
+        self._pod_deletion_enabled = pod_deletion_enabled
+        self._validation_enabled = validation_enabled
+
+    # ----------------------------------------------------------- feature bits
+    def is_pod_deletion_enabled(self) -> bool:
+        return self._pod_deletion_enabled
+
+    def is_validation_enabled(self) -> bool:
+        return self._validation_enabled
+
+    # ------------------------------------------------------------ predicates
+    @staticmethod
+    def is_node_unschedulable(node: JsonObj) -> bool:
+        return node_is_unschedulable(node)
+
+    @staticmethod
+    def is_node_condition_ready(node: JsonObj) -> bool:
+        return node_is_ready(node)
+
+    @staticmethod
+    def is_upgrade_requested(node: JsonObj) -> bool:
+        """Reference: IsUpgradeRequested (:322-325)."""
+        annotations = (node.get("metadata") or {}).get("annotations") or {}
+        return (
+            annotations.get(util.get_upgrade_requested_annotation_key())
+            == consts.TRUE_STRING
+        )
+
+    @staticmethod
+    def skip_node_upgrade(node: JsonObj) -> bool:
+        """Reference: SkipNodeUpgrade (:665-668)."""
+        labels = (node.get("metadata") or {}).get("labels") or {}
+        return labels.get(util.get_upgrade_skip_node_label_key()) == consts.TRUE_STRING
+
+    # ------------------------------------------------------- sync-hash oracle
+    def pod_in_sync_with_ds(self, node_state: NodeUpgradeState):
+        """Returns (is_pod_synced, is_orphaned).  Reference: podInSyncWithDS
+        (:299-320) — orphaned pods are never in sync."""
+        if node_state.is_orphaned_pod():
+            return False, True
+        pod_hash = self.pod_manager.get_pod_controller_revision_hash(
+            node_state.driver_pod
+        )
+        ds_hash = self.pod_manager.get_daemonset_controller_revision_hash(
+            node_state.driver_daemonset
+        )
+        return pod_hash == ds_hash, False
+
+    def is_driver_pod_in_sync(self, node_state: NodeUpgradeState) -> bool:
+        """Revision synced + Running + every container Ready (reference:
+        isDriverPodInSync, :605-634)."""
+        synced, orphaned = self.pod_in_sync_with_ds(node_state)
+        if orphaned or not synced:
+            return False
+        pod = node_state.driver_pod
+        if pod_phase(pod) != "Running":
+            return False
+        statuses = (pod.get("status") or {}).get("containerStatuses") or []
+        if not statuses:
+            return False
+        return all(s.get("ready", False) for s in statuses)
+
+    @staticmethod
+    def is_driver_pod_failing(pod: JsonObj) -> bool:
+        """Reference: isDriverPodFailing (:636-648) — any init/main container
+        not Ready with restartCount > threshold."""
+        status = pod.get("status") or {}
+        for s in (status.get("initContainerStatuses") or []) + (
+            status.get("containerStatuses") or []
+        ):
+            if not s.get("ready", False) and int(
+                s.get("restartCount", 0)
+            ) > POD_RESTART_FAILURE_THRESHOLD:
+                return True
+        return False
+
+    # ------------------------------------------------------------- processors
+    def process_done_or_unknown_nodes(
+        self, state: ClusterUpgradeState, state_name: str
+    ) -> None:
+        """Reference: ProcessDoneOrUnknownNodes (:229-291)."""
+        for node_state in state.nodes_in(state_name):
+            node = node_state.node
+            synced, orphaned = self.pod_in_sync_with_ds(node_state)
+            requested = self.is_upgrade_requested(node)
+            waiting_safe_load = (
+                self.safe_driver_load_manager.is_waiting_for_safe_driver_load(node)
+            )
+            if (not synced and not orphaned) or waiting_safe_load or requested:
+                # Record pre-existing unschedulability so the final uncordon
+                # is skipped for nodes that started out cordoned (:250-264).
+                if self.is_node_unschedulable(node):
+                    self.provider.change_node_upgrade_annotation(
+                        node,
+                        util.get_upgrade_initial_state_annotation_key(),
+                        consts.TRUE_STRING,
+                    )
+                self.provider.change_node_upgrade_state(
+                    node, consts.UPGRADE_STATE_UPGRADE_REQUIRED
+                )
+                continue
+            if state_name == consts.UPGRADE_STATE_UNKNOWN:
+                self.provider.change_node_upgrade_state(
+                    node, consts.UPGRADE_STATE_DONE
+                )
+
+    def process_cordon_required_nodes(self, state: ClusterUpgradeState) -> None:
+        """Reference: ProcessCordonRequiredNodes (:361-380)."""
+        for node_state in state.nodes_in(consts.UPGRADE_STATE_CORDON_REQUIRED):
+            self.cordon_manager.cordon(node_state.node)
+            self.provider.change_node_upgrade_state(
+                node_state.node, consts.UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED
+            )
+
+    def process_wait_for_jobs_required_nodes(
+        self,
+        state: ClusterUpgradeState,
+        wait_for_completion_spec: Optional[WaitForCompletionSpec],
+    ) -> None:
+        """Reference: ProcessWaitForJobsRequiredNodes (:384-419)."""
+        node_states = state.nodes_in(consts.UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED)
+        if (
+            wait_for_completion_spec is None
+            or not wait_for_completion_spec.pod_selector
+        ):
+            next_state = (
+                consts.UPGRADE_STATE_POD_DELETION_REQUIRED
+                if self.is_pod_deletion_enabled()
+                else consts.UPGRADE_STATE_DRAIN_REQUIRED
+            )
+            for node_state in node_states:
+                self.provider.change_node_upgrade_state(
+                    node_state.node, next_state
+                )
+            return
+        if not node_states:
+            return
+        self.pod_manager.schedule_check_on_pod_completion(
+            PodManagerConfig(
+                nodes=[ns.node for ns in node_states],
+                wait_for_completion_spec=wait_for_completion_spec,
+            )
+        )
+
+    def process_pod_deletion_required_nodes(
+        self,
+        state: ClusterUpgradeState,
+        pod_deletion_spec: Optional[PodDeletionSpec],
+        drain_enabled: bool,
+    ) -> None:
+        """Reference: ProcessPodDeletionRequiredNodes (:424-453)."""
+        node_states = state.nodes_in(consts.UPGRADE_STATE_POD_DELETION_REQUIRED)
+        if not self.is_pod_deletion_enabled():
+            for node_state in node_states:
+                self.provider.change_node_upgrade_state(
+                    node_state.node, consts.UPGRADE_STATE_DRAIN_REQUIRED
+                )
+            return
+        if not node_states:
+            return
+        self.pod_manager.schedule_pod_eviction(
+            PodManagerConfig(
+                nodes=[ns.node for ns in node_states],
+                deletion_spec=pod_deletion_spec or PodDeletionSpec(),
+                drain_enabled=drain_enabled,
+            )
+        )
+
+    def process_drain_nodes(
+        self, state: ClusterUpgradeState, drain_spec: Optional[DrainSpec]
+    ) -> None:
+        """Reference: ProcessDrainNodes (:329-357) — drain disabled moves
+        nodes straight to pod-restart-required."""
+        node_states = state.nodes_in(consts.UPGRADE_STATE_DRAIN_REQUIRED)
+        if drain_spec is None or not drain_spec.enable:
+            for node_state in node_states:
+                self.provider.change_node_upgrade_state(
+                    node_state.node, consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+                )
+            return
+        if not node_states:
+            return
+        self.drain_manager.schedule_nodes_drain(
+            DrainConfiguration(
+                spec=drain_spec, nodes=[ns.node for ns in node_states]
+            )
+        )
+
+    def process_pod_restart_nodes(self, state: ClusterUpgradeState) -> None:
+        """Reference: ProcessPodRestartNodes (:457-524)."""
+        pods_to_restart: List[JsonObj] = []
+        for node_state in state.nodes_in(consts.UPGRADE_STATE_POD_RESTART_REQUIRED):
+            node = node_state.node
+            synced, orphaned = self.pod_in_sync_with_ds(node_state)
+            if not synced or orphaned:
+                # Restart only pods not already terminating (:468-474).
+                if not node_state.driver_pod.get("metadata", {}).get(
+                    "deletionTimestamp"
+                ):
+                    pods_to_restart.append(node_state.driver_pod)
+                continue
+            # Pod is at the right revision: release a blocked driver init
+            # container before checking readiness (:476-481).
+            self.safe_driver_load_manager.unblock_loading(node)
+            if self.is_driver_pod_in_sync(node_state):
+                if not self.is_validation_enabled():
+                    self.update_node_to_uncordon_or_done_state(node_state)
+                    continue
+                self.provider.change_node_upgrade_state(
+                    node, consts.UPGRADE_STATE_VALIDATION_REQUIRED
+                )
+            elif self.is_driver_pod_failing(node_state.driver_pod):
+                log_event(
+                    self.recorder,
+                    name_of(node),
+                    "Warning",
+                    util.get_event_reason(),
+                    "Driver pod is failing with repeated restarts",
+                )
+                self.provider.change_node_upgrade_state(
+                    node, consts.UPGRADE_STATE_FAILED
+                )
+        self.pod_manager.schedule_pods_restart(pods_to_restart)
+
+    def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
+        """Self-healing of failed nodes once the pod is back in sync
+        (reference: ProcessUpgradeFailedNodes, :528-570)."""
+        for node_state in state.nodes_in(consts.UPGRADE_STATE_FAILED):
+            if not self.is_driver_pod_in_sync(node_state):
+                continue
+            node = node_state.node
+            annotations = (node.get("metadata") or {}).get("annotations") or {}
+            initial_key = util.get_upgrade_initial_state_annotation_key()
+            if initial_key in annotations:
+                self.provider.change_node_upgrade_state(
+                    node, consts.UPGRADE_STATE_DONE
+                )
+                self.provider.change_node_upgrade_annotation(
+                    node, initial_key, consts.NULL_STRING
+                )
+            else:
+                self.provider.change_node_upgrade_state(
+                    node, consts.UPGRADE_STATE_UNCORDON_REQUIRED
+                )
+
+    def process_validation_required_nodes(self, state: ClusterUpgradeState) -> None:
+        """Reference: ProcessValidationRequiredNodes (:573-604)."""
+        for node_state in state.nodes_in(consts.UPGRADE_STATE_VALIDATION_REQUIRED):
+            node = node_state.node
+            # The driver may have restarted after entering validation; make
+            # sure it is not blocked on safe load (:576-583).
+            self.safe_driver_load_manager.unblock_loading(node)
+            if not self.validation_manager.validate(node):
+                continue
+            self.update_node_to_uncordon_or_done_state(node_state)
+
+    def update_node_to_uncordon_or_done_state(
+        self, node_state: NodeUpgradeState
+    ) -> None:
+        """Reference: updateNodeToUncordonOrDoneState (:673-708)."""
+        node = node_state.node
+        annotations = (node.get("metadata") or {}).get("annotations") or {}
+        initial_key = util.get_upgrade_initial_state_annotation_key()
+        requestor_mode = util.is_node_in_requestor_mode(node)
+        new_state = consts.UPGRADE_STATE_UNCORDON_REQUIRED
+        if initial_key in annotations and not requestor_mode:
+            # Node was already unschedulable before the upgrade: leave it
+            # cordoned and finish.
+            new_state = consts.UPGRADE_STATE_DONE
+        self.provider.change_node_upgrade_state(node, new_state)
+        if new_state == consts.UPGRADE_STATE_DONE or requestor_mode:
+            if initial_key in annotations:
+                self.provider.change_node_upgrade_annotation(
+                    node, initial_key, consts.NULL_STRING
+                )
+
+    # ------------------------------------------------------------------ census
+    def get_total_managed_nodes(self, state: ClusterUpgradeState) -> int:
+        """Reference: GetTotalManagedNodes (:712-728) — known buckets only."""
+        return len(state.managed_node_states())
+
+    def get_upgrades_in_progress(self, state: ClusterUpgradeState) -> int:
+        """Reference: GetUpgradesInProgress (:730-737) — everything not
+        unknown/done/upgrade-required."""
+        idle = (
+            len(state.nodes_in(consts.UPGRADE_STATE_UNKNOWN))
+            + len(state.nodes_in(consts.UPGRADE_STATE_DONE))
+            + len(state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED))
+        )
+        return self.get_total_managed_nodes(state) - idle
+
+    def get_upgrades_done(self, state: ClusterUpgradeState) -> int:
+        return len(state.nodes_in(consts.UPGRADE_STATE_DONE))
+
+    def get_upgrades_failed(self, state: ClusterUpgradeState) -> int:
+        return len(state.nodes_in(consts.UPGRADE_STATE_FAILED))
+
+    def get_upgrades_pending(self, state: ClusterUpgradeState) -> int:
+        return len(state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED))
+
+    def get_current_unavailable_nodes(self, state: ClusterUpgradeState) -> int:
+        """Cordoned or not-ready nodes (reference: :146-165)."""
+        return sum(
+            1
+            for ns in state.managed_node_states()
+            if topology.node_is_unavailable(ns.node)
+        )
+
+    def get_upgrades_available(
+        self,
+        state: ClusterUpgradeState,
+        max_parallel_upgrades: int,
+        max_unavailable: int,
+        slice_aware: bool = False,
+    ) -> int:
+        """Upgrade-slot computation (reference: GetUpgradesAvailable,
+        :748-776).  With ``slice_aware`` every term is counted in slice
+        domains instead of nodes; the returned slot count is then in
+        domain units."""
+        if slice_aware:
+            all_nodes = [ns.node for ns in state.managed_node_states()]
+            idle_states = (
+                consts.UPGRADE_STATE_UNKNOWN,
+                consts.UPGRADE_STATE_DONE,
+                consts.UPGRADE_STATE_UPGRADE_REQUIRED,
+            )
+            active_domains = {
+                topology.domain_of(ns.node)
+                for st, nss in state.node_states.items()
+                if st in consts.ALL_STATES and st not in idle_states
+                for ns in nss
+            }
+            upgrades_in_progress = len(active_domains)
+            total = topology.count_domains(all_nodes)
+            current_unavailable = topology.count_unavailable_domains(all_nodes)
+            about_to_cordon = len(
+                {
+                    topology.domain_of(ns.node)
+                    for ns in state.nodes_in(consts.UPGRADE_STATE_CORDON_REQUIRED)
+                }
+            )
+        else:
+            upgrades_in_progress = self.get_upgrades_in_progress(state)
+            total = self.get_total_managed_nodes(state)
+            current_unavailable = self.get_current_unavailable_nodes(state)
+            about_to_cordon = len(
+                state.nodes_in(consts.UPGRADE_STATE_CORDON_REQUIRED)
+            )
+
+        if max_parallel_upgrades == 0:
+            # No parallelism limit: every upgrade-required node may start.
+            available = self.get_upgrades_pending(state)
+        else:
+            available = max_parallel_upgrades - upgrades_in_progress
+
+        # Apply the maxUnavailable constraint, counting nodes about to be
+        # cordoned as already unavailable (:762-775).
+        unavailable_now = current_unavailable + about_to_cordon
+        if available > max_unavailable:
+            available = max_unavailable
+        if unavailable_now >= max_unavailable:
+            available = 0
+        elif max_unavailable < total and unavailable_now + available > max_unavailable:
+            available = max_unavailable - unavailable_now
+        return available
+
+    # ------------------------------------------------------- snapshot helpers
+    def get_driver_daemon_sets(
+        self, namespace: str, labels: Dict[str, str]
+    ) -> Dict[str, JsonObj]:
+        """uid → DaemonSet map (reference: GetDriverDaemonSets, :168-187)."""
+        from ..cluster.selectors import labels_to_selector
+
+        out: Dict[str, JsonObj] = {}
+        for ds in self._cluster.list(
+            "DaemonSet", namespace=namespace,
+            label_selector=labels_to_selector(labels),
+        ):
+            out[ds["metadata"]["uid"]] = ds
+        return out
+
+    @staticmethod
+    def is_orphaned_pod(pod: JsonObj) -> bool:
+        """Reference: IsOrphanedPod (:221-223)."""
+        return len(owner_references(pod)) < 1
+
+    def get_pods_owned_by_ds(
+        self, ds: JsonObj, pods: List[JsonObj]
+    ) -> List[JsonObj]:
+        """Reference: GetPodsOwnedbyDs (:190-208)."""
+        return [
+            p
+            for p in pods
+            if not self.is_orphaned_pod(p) and is_owned_by(p, ds)
+        ]
+
+    def get_orphaned_pods(self, pods: List[JsonObj]) -> List[JsonObj]:
+        """Reference: GetOrphanedPods (:211-219)."""
+        return [p for p in pods if self.is_orphaned_pod(p)]

@@ -75,6 +75,12 @@ class PodManager:
         self._filter = pod_deletion_filter
         self._nodes_in_progress = StringSet()
 
+    def set_pod_deletion_filter(self, pod_deletion_filter: PodDeletionFilter) -> None:
+        """Install the consumer's eviction predicate (reference passes it to
+        NewPodManager, pod_manager.go:407-422; the builder pattern on the
+        state manager sets it post-construction)."""
+        self._filter = pod_deletion_filter
+
     # ---------------------------------------------------- revision-hash oracle
     def get_pod_controller_revision_hash(self, pod: JsonObj) -> str:
         """Reference: GetPodControllerRevisionHash (pod_manager.go:84-89)."""
@@ -92,13 +98,22 @@ class PodManager:
         GetDaemonsetControllerRevisionHash, pod_manager.go:92-119 — sorts by
         .revision, takes the highest, strips the name prefix)."""
         ds_name = name_of(daemonset)
+        # Ownership is the authoritative filter; the name-prefix fallback is
+        # only for revisions that carry no ownerReferences at all (e.g.
+        # restored from a backup).  A bare prefix match alone would also
+        # capture another DaemonSet's revisions when names overlap
+        # ("tpu-runtime" vs "tpu-runtime-v2") — the reference avoids this by
+        # filtering with the DS's label selector first (pod_manager.go:95).
         revisions = [
             cr
             for cr in self._cluster.list(
                 "ControllerRevision", namespace=namespace_of(daemonset)
             )
             if is_owned_by(cr, daemonset)
-            or name_of(cr).startswith(f"{ds_name}-")
+            or (
+                not (cr.get("metadata") or {}).get("ownerReferences")
+                and name_of(cr).startswith(f"{ds_name}-")
+            )
         ]
         if not revisions:
             raise PodManagerError(f"no revision found for daemonset {ds_name}")

@@ -1,0 +1,150 @@
+"""In-place mode strategy — the library cordons/drains/uncordons itself.
+
+Reference parity: ``pkg/upgrade/upgrade_inplace.go`` (C3) —
+
+* ``process_upgrade_required_nodes`` (:44-112): resolves
+  ``maxUnavailable`` (percent → count, round-up) against the managed
+  total, computes slots via the common manager, then moves nodes to
+  ``cordon-required`` — removing the upgrade-requested annotation,
+  honouring the skip label, and letting *already-cordoned* nodes bypass
+  the throttle (:87-97);
+* ``process_uncordon_required_nodes`` (:124-147): uncordons and
+  finishes, skipping nodes under requestor-mode ownership;
+* ``process_node_maintenance_required_nodes``: no-op in this mode
+  (:116-122).
+
+TPU-native: with ``policy.slice_aware`` the throttle operates in slice
+domains and all upgrade-required nodes of a chosen domain are
+co-scheduled, so a multi-host slice goes down once instead of
+host-by-host (see :mod:`..tpu.topology`).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+from ..api.upgrade_spec import UpgradePolicySpec
+from ..tpu import topology
+from . import consts, util
+from .common_manager import ClusterUpgradeState, CommonUpgradeManager, NodeUpgradeState
+
+logger = logging.getLogger(__name__)
+
+
+class InplaceNodeStateManager:
+    def __init__(self, common: CommonUpgradeManager) -> None:
+        self._common = common
+
+    # ---------------------------------------------------- upgrade-required
+    def process_upgrade_required_nodes(
+        self, state: ClusterUpgradeState, policy: UpgradePolicySpec
+    ) -> None:
+        common = self._common
+        slice_aware = policy.slice_aware
+        if slice_aware:
+            total = topology.count_domains(
+                ns.node for ns in state.all_node_states()
+            )
+        else:
+            total = common.get_total_managed_nodes(state)
+        max_unavailable = total
+        if policy.max_unavailable is not None:
+            max_unavailable = policy.max_unavailable.scaled_value(
+                total, round_up=True
+            )
+        available = common.get_upgrades_available(
+            state,
+            policy.max_parallel_upgrades,
+            max_unavailable,
+            slice_aware=slice_aware,
+        )
+        logger.info(
+            "upgrade slots: available=%d maxParallel=%d maxUnavailable=%d "
+            "total=%d slice_aware=%s",
+            available,
+            policy.max_parallel_upgrades,
+            max_unavailable,
+            total,
+            slice_aware,
+        )
+
+        node_states = state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        if slice_aware:
+            self._schedule_by_domain(node_states, available)
+        else:
+            self._schedule_by_node(node_states, available)
+
+    def _prepare(self, node_state: NodeUpgradeState) -> bool:
+        """Annotation/skip handling; returns False if the node must be
+        skipped (reference :72-86)."""
+        common = self._common
+        node = node_state.node
+        if common.is_upgrade_requested(node):
+            common.provider.change_node_upgrade_annotation(
+                node,
+                util.get_upgrade_requested_annotation_key(),
+                consts.NULL_STRING,
+            )
+        if common.skip_node_upgrade(node):
+            logger.info(
+                "node %s is marked to skip upgrades",
+                (node.get("metadata") or {}).get("name", ""),
+            )
+            return False
+        return True
+
+    def _schedule_by_node(
+        self, node_states: List[NodeUpgradeState], available: int
+    ) -> None:
+        common = self._common
+        for node_state in node_states:
+            if not self._prepare(node_state):
+                continue
+            node = node_state.node
+            if available <= 0 and not common.is_node_unschedulable(node):
+                # Limit reached; only manually-cordoned nodes may proceed
+                # (reference :87-97).
+                continue
+            common.provider.change_node_upgrade_state(
+                node, consts.UPGRADE_STATE_CORDON_REQUIRED
+            )
+            available -= 1
+
+    def _schedule_by_domain(
+        self, node_states: List[NodeUpgradeState], available: int
+    ) -> None:
+        """Slice-aware scheduling: one slot = one domain; all of a chosen
+        domain's upgrade-required nodes advance together."""
+        common = self._common
+        eligible = [ns for ns in node_states if self._prepare(ns)]
+        domains = topology.group_by_domain([ns.node for ns in eligible])
+        for domain, nodes in domains.items():
+            bypass = any(common.is_node_unschedulable(n) for n in nodes)
+            if available <= 0 and not bypass:
+                continue
+            for node in nodes:
+                common.provider.change_node_upgrade_state(
+                    node, consts.UPGRADE_STATE_CORDON_REQUIRED
+                )
+            if not bypass:
+                available -= 1
+
+    # ------------------------------------------------- node-maintenance (n/a)
+    def process_node_maintenance_required_nodes(
+        self, state: ClusterUpgradeState
+    ) -> None:
+        """No-op for in-place mode (reference :116-122)."""
+
+    # ---------------------------------------------------- uncordon-required
+    def process_uncordon_required_nodes(self, state: ClusterUpgradeState) -> None:
+        common = self._common
+        for node_state in state.nodes_in(consts.UPGRADE_STATE_UNCORDON_REQUIRED):
+            node = node_state.node
+            if util.is_node_in_requestor_mode(node):
+                # handled by the requestor flow (reference :131-134)
+                continue
+            common.cordon_manager.uncordon(node)
+            common.provider.change_node_upgrade_state(
+                node, consts.UPGRADE_STATE_DONE
+            )

@@ -1,0 +1,308 @@
+"""ClusterUpgradeStateManager — the L4 state-machine driver.
+
+Reference parity: ``pkg/upgrade/upgrade_state.go`` (C1) —
+
+* ``NewClusterUpgradeStateManager`` (:65-92) constructing the L2 managers;
+  builder switches ``WithPodDeletionEnabled`` (:329-337) and
+  ``WithValidationEnabled`` (:341-350);
+* ``BuildState`` (:99-164): snapshot of driver DaemonSets + pods, per-DS
+  ownership filter, hard error on unscheduled pods, orphaned-pod
+  collection, skip of pending unassigned pods, bucketing by the
+  upgrade-state node label;
+* ``ApplyState`` (:171-281): the 11-phase sequential loop over state
+  buckets — stateless and idempotent; every decision is derived from the
+  snapshot, and async work (drain/eviction) reports through node labels
+  picked up by the *next* reconcile;
+* mode dispatch wrappers (:287-325): upgrade-required / node-maintenance /
+  uncordon processors run through the in-place or requestor strategy —
+  with both uncordon processors run so nodes that started in-place finish
+  in-place even after requestor mode is enabled (:311-325).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from ..api.upgrade_spec import UpgradePolicySpec
+from ..cluster.cache import InformerCache
+from ..cluster.errors import NotFoundError
+from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.selectors import labels_to_selector
+from . import consts, util
+from .common_manager import (
+    ClusterUpgradeState,
+    CommonUpgradeManager,
+    NodeUpgradeState,
+)
+from .cordon_manager import CordonManager
+from .drain_manager import DrainManager, PreDrainGate
+from .node_upgrade_state_provider import NodeUpgradeStateProvider
+from .pod_manager import PodDeletionFilter, PodManager
+from .safe_driver_load_manager import SafeDriverLoadManager
+from .upgrade_inplace import InplaceNodeStateManager
+from .util import EventRecorder
+from .validation_manager import ValidationManager
+
+logger = logging.getLogger(__name__)
+
+
+class UpgradeStateError(Exception):
+    pass
+
+
+class ClusterUpgradeStateManager:
+    """Build + apply the cluster upgrade state each reconcile."""
+
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        cache: Optional[InformerCache] = None,
+        recorder: Optional[EventRecorder] = None,
+        requestor: Optional[object] = None,
+        use_maintenance_operator: bool = False,
+        pre_drain_gate: Optional[PreDrainGate] = None,
+        cache_sync_timeout_seconds: float = 10.0,
+        cache_sync_poll_seconds: float = 1.0,
+        # test injection points (the reference wires mocks the same way,
+        # upgrade_suit_test.go:114-182)
+        provider: Optional[NodeUpgradeStateProvider] = None,
+        cordon_manager: Optional[CordonManager] = None,
+        drain_manager: Optional[DrainManager] = None,
+        pod_manager: Optional[PodManager] = None,
+        validation_manager: Optional[ValidationManager] = None,
+        safe_driver_load_manager: Optional[SafeDriverLoadManager] = None,
+    ) -> None:
+        self._cluster = cluster
+        self._cache = cache or InformerCache(cluster, lag_seconds=0.0)
+        self._recorder = recorder
+        self._provider = provider or NodeUpgradeStateProvider(
+            cluster,
+            self._cache,
+            recorder,
+            cache_sync_timeout_seconds=cache_sync_timeout_seconds,
+            cache_sync_poll_seconds=cache_sync_poll_seconds,
+        )
+        self._cordon_manager = cordon_manager or CordonManager(cluster, recorder)
+        self._drain_manager = drain_manager or DrainManager(
+            cluster, self._provider, recorder, pre_drain_gate=pre_drain_gate
+        )
+        self._pod_manager = pod_manager or PodManager(
+            cluster, self._provider, recorder
+        )
+        self._validation_manager = validation_manager or ValidationManager(
+            cluster, self._provider, recorder
+        )
+        self._safe_load_manager = safe_driver_load_manager or SafeDriverLoadManager(
+            self._provider
+        )
+        self._pod_deletion_enabled = False
+        self._validation_enabled = False
+        self._common: Optional[CommonUpgradeManager] = None
+        self._inplace: Optional[InplaceNodeStateManager] = None
+        self._requestor = requestor
+        self._use_maintenance_operator = use_maintenance_operator
+
+    # ------------------------------------------------------------- builders
+    def with_pod_deletion_enabled(
+        self, pod_deletion_filter: PodDeletionFilter
+    ) -> "ClusterUpgradeStateManager":
+        """Enable the optional pod-deletion state (reference :329-337)."""
+        self._pod_manager.set_pod_deletion_filter(pod_deletion_filter)
+        self._pod_deletion_enabled = True
+        self._common = None
+        return self
+
+    def with_validation_enabled(self, pod_selector: str) -> "ClusterUpgradeStateManager":
+        """Enable the optional validation state (reference :341-350)."""
+        if not pod_selector:
+            raise UpgradeStateError("validation pod selector must be non-empty")
+        self._validation_manager.pod_selector = pod_selector
+        self._validation_enabled = True
+        self._common = None
+        return self
+
+    def with_requestor(self, requestor, enabled: bool = True) -> "ClusterUpgradeStateManager":
+        """Attach the requestor-mode strategy (maintenance-operator handoff)."""
+        self._requestor = requestor
+        self._use_maintenance_operator = enabled
+        return self
+
+    # ------------------------------------------------------------ accessors
+    @property
+    def common(self) -> CommonUpgradeManager:
+        if self._common is None:
+            self._common = CommonUpgradeManager(
+                self._cluster,
+                self._provider,
+                self._cordon_manager,
+                self._drain_manager,
+                self._pod_manager,
+                self._validation_manager,
+                self._safe_load_manager,
+                self._recorder,
+                pod_deletion_enabled=self._pod_deletion_enabled,
+                validation_enabled=self._validation_enabled,
+            )
+            self._inplace = InplaceNodeStateManager(self._common)
+        return self._common
+
+    @property
+    def inplace(self) -> InplaceNodeStateManager:
+        _ = self.common
+        assert self._inplace is not None
+        return self._inplace
+
+    @property
+    def provider(self) -> NodeUpgradeStateProvider:
+        return self._provider
+
+    @property
+    def drain_manager(self) -> DrainManager:
+        return self._drain_manager
+
+    @property
+    def pod_manager(self) -> PodManager:
+        return self._pod_manager
+
+    def get_requestor(self):
+        """Reference: GetRequestor (upgrade_state.go:283-285)."""
+        return self._requestor
+
+    # ------------------------------------------------------------ BuildState
+    def build_state(
+        self, namespace: str, driver_labels: Dict[str, str]
+    ) -> ClusterUpgradeState:
+        """Snapshot construction (reference: BuildState, :99-164)."""
+        common = self.common
+        state = ClusterUpgradeState()
+        daemon_sets = common.get_driver_daemon_sets(namespace, driver_labels)
+        pods = self._cluster.list(
+            "Pod",
+            namespace=namespace,
+            label_selector=labels_to_selector(driver_labels),
+        )
+
+        filtered: List[JsonObj] = []
+        for ds in daemon_sets.values():
+            ds_pods = common.get_pods_owned_by_ds(ds, pods)
+            desired = (ds.get("status") or {}).get("desiredNumberScheduled", 0)
+            if int(desired) != len(ds_pods):
+                raise UpgradeStateError(
+                    f"driver DaemonSet {ds['metadata']['name']} should not "
+                    f"have unscheduled pods (desired {desired}, found "
+                    f"{len(ds_pods)})"
+                )
+            filtered.extend(ds_pods)
+        filtered.extend(common.get_orphaned_pods(pods))
+
+        state_label = util.get_upgrade_state_label_key()
+        for pod in filtered:
+            owner_ds = None
+            if not common.is_orphaned_pod(pod):
+                owner_uid = (pod["metadata"]["ownerReferences"][0]).get("uid")
+                owner_ds = daemon_sets.get(owner_uid)
+            node_name = (pod.get("spec") or {}).get("nodeName", "")
+            if not node_name and (pod.get("status") or {}).get("phase") == "Pending":
+                logger.info(
+                    "driver pod %s has no node assigned, skipping",
+                    pod["metadata"]["name"],
+                )
+                continue
+            node_state = self._build_node_upgrade_state(pod, owner_ds)
+            bucket = ((node_state.node.get("metadata") or {}).get("labels") or {}).get(
+                state_label, consts.UPGRADE_STATE_UNKNOWN
+            )
+            state.node_states.setdefault(bucket, []).append(node_state)
+        return state
+
+    def _build_node_upgrade_state(
+        self, pod: JsonObj, ds: Optional[JsonObj]
+    ) -> NodeUpgradeState:
+        """Reference: buildNodeUpgradeState (:354-378) — node read through
+        the informer cache."""
+        node_name = (pod.get("spec") or {}).get("nodeName", "")
+        try:
+            node = self._provider.get_node(node_name)
+        except NotFoundError as err:
+            raise UpgradeStateError(
+                f"node {node_name} for driver pod "
+                f"{pod['metadata']['name']} not found"
+            ) from err
+        node_state = NodeUpgradeState(node=node, driver_pod=pod, driver_daemonset=ds)
+        if self._requestor is not None and hasattr(
+            self._requestor, "attach_node_maintenance"
+        ):
+            self._requestor.attach_node_maintenance(node_state)
+        return node_state
+
+    # ------------------------------------------------------------ ApplyState
+    def apply_state(
+        self, state: Optional[ClusterUpgradeState], policy: Optional[UpgradePolicySpec]
+    ) -> None:
+        """The 11-phase hot loop (reference: ApplyState, :171-281)."""
+        if state is None:
+            raise UpgradeStateError("currentState should not be empty")
+        if policy is None or not policy.auto_upgrade:
+            logger.info("auto upgrade is disabled, skipping")
+            return
+        common = self.common
+
+        logger.info(
+            "node states: %s",
+            {k or "unknown": len(v) for k, v in state.node_states.items()},
+        )
+
+        # 1-2. classify unknown + done nodes
+        common.process_done_or_unknown_nodes(state, consts.UPGRADE_STATE_UNKNOWN)
+        common.process_done_or_unknown_nodes(state, consts.UPGRADE_STATE_DONE)
+        # 3. start upgrades up to the throttle (mode dispatch)
+        self._process_upgrade_required_nodes_wrapper(state, policy)
+        # 4. cordon
+        common.process_cordon_required_nodes(state)
+        # 5. wait for jobs
+        common.process_wait_for_jobs_required_nodes(
+            state, policy.wait_for_completion
+        )
+        # 6. pod deletion
+        drain_enabled = policy.drain_spec is not None and policy.drain_spec.enable
+        common.process_pod_deletion_required_nodes(
+            state, policy.pod_deletion, drain_enabled
+        )
+        # 7. drain
+        common.process_drain_nodes(state, policy.drain_spec)
+        # 8. node-maintenance (requestor mode only)
+        self._process_node_maintenance_required_nodes_wrapper(state)
+        # 9. pod restart (+ failure detection)
+        common.process_pod_restart_nodes(state)
+        # 10. failed-node self-healing, then validation
+        common.process_upgrade_failed_nodes(state)
+        common.process_validation_required_nodes(state)
+        # 11. uncordon (both modes' processors run — reference :311-325)
+        self._process_uncordon_required_nodes_wrapper(state)
+
+    # ---------------------------------------------------- mode dispatchers
+    def _process_upgrade_required_nodes_wrapper(
+        self, state: ClusterUpgradeState, policy: UpgradePolicySpec
+    ) -> None:
+        """Reference: ProcessUpgradeRequiredNodesWrapper (:287-297)."""
+        if self._use_maintenance_operator and self._requestor is not None:
+            self._requestor.process_upgrade_required_nodes(state, policy)
+        else:
+            self.inplace.process_upgrade_required_nodes(state, policy)
+
+    def _process_node_maintenance_required_nodes_wrapper(
+        self, state: ClusterUpgradeState
+    ) -> None:
+        """Reference: ProcessNodeMaintenanceRequiredNodesWrapper (:299-309)."""
+        if self._use_maintenance_operator and self._requestor is not None:
+            self._requestor.process_node_maintenance_required_nodes(state)
+
+    def _process_uncordon_required_nodes_wrapper(
+        self, state: ClusterUpgradeState
+    ) -> None:
+        """Both processors run so nodes that started in-place finish
+        in-place after requestor mode is enabled (reference :311-325)."""
+        if self._use_maintenance_operator and self._requestor is not None:
+            self._requestor.process_uncordon_required_nodes(state)
+        self.inplace.process_uncordon_required_nodes(state)

@@ -180,6 +180,17 @@ def get_event_reason() -> str:
     return "%sUpgrade" % get_component_name()
 
 
+def is_node_in_requestor_mode(node) -> bool:
+    """True when this node's upgrade is delegated to the external
+    maintenance operator (reference: IsNodeInRequestorMode, util.go:134-138
+    — tracked by a node annotation)."""
+    annotations = (node.get("metadata") or {}).get("annotations") or {}
+    return (
+        annotations.get(get_upgrade_requestor_mode_annotation_key())
+        == consts.TRUE_STRING
+    )
+
+
 # --------------------------------------------------------------------------
 # Events (reference: util.go:162-177 — nil-safe logEvent helpers)
 # --------------------------------------------------------------------------

@@ -1,0 +1,141 @@
+"""Test harness: a simulated driver-DaemonSet fleet over the in-memory
+apiserver.
+
+The analog of the reference's envtest builder fixtures
+(upgrade_suit_test.go:216-428): nodes, a driver DaemonSet with
+ControllerRevisions, driver pods, and a fake "DaemonSet controller" that
+recreates deleted driver pods at the current revision — which is the one
+controller behavior the state machine's restart phase depends on (envtest
+has no controllers either; the reference tests hand-create replacement
+pods the same way).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from k8s_operator_libs_tpu.cluster.inmem import InMemoryCluster, JsonObj
+from k8s_operator_libs_tpu.cluster.objects import (
+    get_label,
+    make_controller_revision,
+    make_daemonset,
+    make_node,
+    make_pod,
+)
+from k8s_operator_libs_tpu.upgrade import util
+
+NAMESPACE = "tpu-ops"
+DRIVER_LABELS = {"app": "tpu-runtime"}
+
+
+class Fleet:
+    """A driver DaemonSet + nodes + driver pods, with revision control."""
+
+    def __init__(self, cluster: InMemoryCluster, revision_hash: str = "rev1"):
+        self.cluster = cluster
+        self.revision = 1
+        self.revision_hash = revision_hash
+        self.ds = cluster.create(
+            make_daemonset("tpu-runtime", NAMESPACE, dict(DRIVER_LABELS))
+        )
+        cluster.create(
+            make_controller_revision(self.ds, self.revision, revision_hash)
+        )
+        self._pod_seq = itertools.count()
+
+    # ------------------------------------------------------------- building
+    def add_node(
+        self,
+        name: str,
+        *,
+        pod_hash: Optional[str] = None,
+        ready: bool = True,
+        unschedulable: bool = False,
+        labels: Optional[Dict[str, str]] = None,
+        annotations: Optional[Dict[str, str]] = None,
+        pod_ready: bool = True,
+        restart_count: int = 0,
+    ) -> JsonObj:
+        node = self.cluster.create(
+            make_node(
+                name,
+                labels=labels,
+                annotations=annotations,
+                ready=ready,
+                unschedulable=unschedulable,
+            )
+        )
+        pod = make_pod(
+            f"tpu-runtime-{next(self._pod_seq)}",
+            NAMESPACE,
+            name,
+            labels=dict(DRIVER_LABELS),
+            owner=self.ds,
+            revision_hash=pod_hash or self.revision_hash,
+            ready=pod_ready,
+            restart_count=restart_count,
+        )
+        pod["status"]["containerStatuses"][0]["ready"] = pod_ready
+        self.cluster.create(pod)
+        self._bump_desired(+1)
+        return node
+
+    def _bump_desired(self, delta: int) -> None:
+        ds = self.cluster.get("DaemonSet", "tpu-runtime", NAMESPACE)
+        ds["status"]["desiredNumberScheduled"] = (
+            ds["status"].get("desiredNumberScheduled", 0) + delta
+        )
+        self.ds = self.cluster.update(ds)
+
+    def publish_new_revision(self, revision_hash: str) -> None:
+        """A new driver version rolls out: newest ControllerRevision changes,
+        existing pods become out of sync."""
+        self.revision += 1
+        self.revision_hash = revision_hash
+        self.cluster.create(
+            make_controller_revision(self.ds, self.revision, revision_hash)
+        )
+
+    # -------------------------------------------------- fake DS controller
+    def reconcile_daemonset(self) -> int:
+        """Recreate missing driver pods at the current revision; returns the
+        number of pods created."""
+        pods = self.cluster.list(
+            "Pod",
+            namespace=NAMESPACE,
+            label_selector="app=tpu-runtime",
+        )
+        covered = {(p.get("spec") or {}).get("nodeName") for p in pods}
+        created = 0
+        for node in self.cluster.list("Node"):
+            name = node["metadata"]["name"]
+            if name in covered:
+                continue
+            pod = make_pod(
+                f"tpu-runtime-{next(self._pod_seq)}",
+                NAMESPACE,
+                name,
+                labels=dict(DRIVER_LABELS),
+                owner=self.ds,
+                revision_hash=self.revision_hash,
+                ready=True,
+            )
+            pod["status"]["containerStatuses"][0]["ready"] = True
+            self.cluster.create(pod)
+            created += 1
+        return created
+
+    # ------------------------------------------------------------- queries
+    def node_state(self, name: str) -> str:
+        return get_label(
+            self.cluster.get("Node", name), util.get_upgrade_state_label_key()
+        )
+
+    def states(self) -> Dict[str, str]:
+        return {
+            n["metadata"]["name"]: get_label(
+                n, util.get_upgrade_state_label_key()
+            )
+            for n in self.cluster.list("Node")
+        }

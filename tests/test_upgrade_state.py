@@ -1,0 +1,592 @@
+"""State-machine integration tests: BuildState + ApplyState.
+
+Reference spec coverage: upgrade_state_test.go (1,865 LoC, ~50 specs) —
+BuildState (empty/scheduled/unscheduled/orphaned), ApplyState transitions
+for every state, the maxParallelUpgrades × maxUnavailable throttle matrix
+(incl. percentages and pre-cordoned nodes), pod-deletion on/off, drain
+policy, pod-restart/safe-load/failure, validation, uncordon, and the
+upgrade-requested annotation flow — plus the TPU slice-aware throttle.
+"""
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    PodDeletionSpec,
+    UpgradePolicySpec,
+    WaitForCompletionSpec,
+)
+from k8s_operator_libs_tpu.cluster import InMemoryCluster
+from k8s_operator_libs_tpu.cluster.objects import (
+    get_annotation,
+    get_label,
+    make_node,
+    make_pod,
+    set_condition,
+)
+from k8s_operator_libs_tpu.upgrade import consts, util
+from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+    ClusterUpgradeStateManager,
+    UpgradeStateError,
+)
+
+from harness import DRIVER_LABELS, NAMESPACE, Fleet
+
+
+@pytest.fixture()
+def fleet(cluster):
+    return Fleet(cluster)
+
+
+def make_manager(cluster, **kwargs):
+    return ClusterUpgradeStateManager(
+        cluster,
+        cache_sync_timeout_seconds=2.0,
+        cache_sync_poll_seconds=0.01,
+        **kwargs,
+    )
+
+
+def reconcile(manager, fleet, policy, cycles=1, settle=True):
+    """One or more reconcile rounds: build → apply → wait for async work →
+    fake DS controller recreates deleted driver pods."""
+    for _ in range(cycles):
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        manager.apply_state(state, policy)
+        if settle:
+            manager.drain_manager.wait_idle(10.0)
+            manager.pod_manager.wait_idle(10.0)
+        fleet.reconcile_daemonset()
+
+
+def run_to_completion(manager, fleet, policy, max_cycles=20):
+    for _ in range(max_cycles):
+        reconcile(manager, fleet, policy)
+        states = set(fleet.states().values())
+        if states == {consts.UPGRADE_STATE_DONE}:
+            return True
+    return False
+
+
+class TestBuildState:
+    def test_empty_cluster(self, cluster):
+        manager = make_manager(cluster)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        assert state.node_states == {}
+
+    def test_buckets_by_state_label(self, cluster, fleet):
+        fleet.add_node("n1")
+        n2 = fleet.add_node("n2")
+        cluster.patch(
+            "Node",
+            "n2",
+            {
+                "metadata": {
+                    "labels": {
+                        util.get_upgrade_state_label_key(): consts.UPGRADE_STATE_DONE
+                    }
+                }
+            },
+        )
+        manager = make_manager(cluster)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        assert len(state.nodes_in(consts.UPGRADE_STATE_UNKNOWN)) == 1
+        assert len(state.nodes_in(consts.UPGRADE_STATE_DONE)) == 1
+
+    def test_unscheduled_pods_hard_error(self, cluster, fleet):
+        fleet.add_node("n1")
+        fleet._bump_desired(+1)  # desired=2 but only one pod exists
+        manager = make_manager(cluster)
+        with pytest.raises(UpgradeStateError, match="unscheduled"):
+            manager.build_state(NAMESPACE, DRIVER_LABELS)
+
+    def test_orphaned_pods_included_without_daemonset(self, cluster, fleet):
+        fleet.add_node("n1")
+        cluster.create(make_node("n-orphan"))
+        cluster.create(
+            make_pod(
+                "orphan-pod",
+                NAMESPACE,
+                "n-orphan",
+                labels=dict(DRIVER_LABELS),
+                revision_hash="whatever",
+            )
+        )
+        manager = make_manager(cluster)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        unknown = state.nodes_in(consts.UPGRADE_STATE_UNKNOWN)
+        assert len(unknown) == 2
+        orphaned = [ns for ns in unknown if ns.is_orphaned_pod()]
+        assert len(orphaned) == 1
+
+    def test_pending_unassigned_pod_skipped(self, cluster, fleet):
+        fleet.add_node("n1")
+        pod = make_pod(
+            "floating",
+            NAMESPACE,
+            "",
+            labels=dict(DRIVER_LABELS),
+            owner=fleet.ds,
+            phase="Pending",
+            revision_hash="x",
+        )
+        cluster.create(pod)
+        fleet._bump_desired(+1)
+        manager = make_manager(cluster)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        assert len(state.all_node_states()) == 1
+
+
+class TestApplyStateGuards:
+    def test_nil_state_rejected(self, cluster):
+        manager = make_manager(cluster)
+        with pytest.raises(UpgradeStateError):
+            manager.apply_state(None, UpgradePolicySpec(auto_upgrade=True))
+
+    def test_disabled_policy_is_noop(self, cluster, fleet):
+        fleet.add_node("n1", pod_hash="old")
+        fleet.publish_new_revision("new")
+        manager = make_manager(cluster)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        manager.apply_state(state, UpgradePolicySpec(auto_upgrade=False))
+        manager.apply_state(state, None)
+        assert fleet.node_state("n1") == ""
+
+
+class TestClassification:
+    def test_in_sync_unknown_becomes_done(self, cluster, fleet):
+        fleet.add_node("n1")
+        manager = make_manager(cluster)
+        reconcile(manager, fleet, UpgradePolicySpec(auto_upgrade=True))
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_DONE
+
+    def test_out_of_sync_becomes_upgrade_required_then_progresses(
+        self, cluster, fleet
+    ):
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(auto_upgrade=True, max_parallel_upgrades=0)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        manager.apply_state(state, policy)
+        # one ApplyState only advances a node through the phases of its
+        # snapshot bucket — classification lands it in upgrade-required and
+        # the throttle picks it up on the NEXT reconcile (the buckets are
+        # fixed at BuildState, reference upgrade_state.go:158-160)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        manager.apply_state(state, policy)
+        assert fleet.node_state("n1") in (
+            consts.UPGRADE_STATE_CORDON_REQUIRED,
+            consts.UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED,
+        )
+
+    def test_upgrade_requested_annotation_forces_cycle(self, cluster, fleet):
+        fleet.add_node("n1")  # in sync
+        cluster.patch(
+            "Node",
+            "n1",
+            {
+                "metadata": {
+                    "annotations": {
+                        util.get_upgrade_requested_annotation_key(): "true"
+                    }
+                }
+            },
+        )
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(auto_upgrade=True)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        manager.apply_state(state, policy)
+        node = cluster.get("Node", "n1")
+        assert (
+            get_label(node, util.get_upgrade_state_label_key())
+            == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        )
+        # next reconcile: the throttle phase consumes the annotation
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        manager.apply_state(state, policy)
+        node = cluster.get("Node", "n1")
+        assert (
+            util.get_upgrade_requested_annotation_key()
+            not in node["metadata"]["annotations"]
+        )
+
+    def test_safe_load_waiting_forces_cycle(self, cluster, fleet):
+        fleet.add_node("n1")
+        cluster.patch(
+            "Node",
+            "n1",
+            {
+                "metadata": {
+                    "annotations": {
+                        util.get_wait_for_safe_load_annotation_key(): "pod-x"
+                    }
+                }
+            },
+        )
+        manager = make_manager(cluster)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        manager.apply_state(state, UpgradePolicySpec(auto_upgrade=True))
+        assert fleet.node_state("n1") != consts.UPGRADE_STATE_DONE
+
+
+class TestFullLifecycle:
+    def test_single_node_full_upgrade(self, cluster, fleet):
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        assert run_to_completion(manager, fleet, policy)
+        node = cluster.get("Node", "n1")
+        assert node["spec"]["unschedulable"] is False  # uncordoned at end
+        pods = cluster.list("Pod", namespace=NAMESPACE)
+        assert [get_label(p, "controller-revision-hash") for p in pods] == ["rev2"]
+
+    def test_multi_node_rolling_upgrade_respects_serial_order(
+        self, cluster, fleet
+    ):
+        for i in range(4):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString("100%"),
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        seen_in_progress = []
+        for _ in range(40):
+            reconcile(manager, fleet, policy)
+            states = fleet.states()
+            in_progress = [
+                n
+                for n, s in states.items()
+                if s
+                not in ("", consts.UPGRADE_STATE_DONE, consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+            ]
+            seen_in_progress.append(len(in_progress))
+            if set(states.values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+        assert max(seen_in_progress) <= 1  # maxParallel=1 honored
+
+    def test_initially_cordoned_node_stays_cordoned(self, cluster, fleet):
+        fleet.add_node("n1", pod_hash="rev1", unschedulable=True)
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        assert run_to_completion(manager, fleet, policy)
+        node = cluster.get("Node", "n1")
+        assert node["spec"]["unschedulable"] is True  # uncordon skipped
+        assert (
+            util.get_upgrade_initial_state_annotation_key()
+            not in node["metadata"]["annotations"]
+        )
+
+    def test_wait_for_jobs_then_pod_deletion_then_drain(self, cluster, fleet):
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        rs = {"kind": "ReplicaSet", "metadata": {"name": "rs", "namespace": "ml"}}
+        cluster.create(
+            make_pod("job", "ml", "n1", labels={"kind": "job"}, owner=rs,
+                     phase="Succeeded")
+        )
+        cluster.create(
+            make_pod("sidecar", "ml", "n1", labels={"kind": "deletable"}, owner=rs)
+        )
+        manager = make_manager(cluster).with_pod_deletion_enabled(
+            lambda pod: get_label(pod, "kind") == "deletable"
+        )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            wait_for_completion=WaitForCompletionSpec(pod_selector="kind=job"),
+            pod_deletion=PodDeletionSpec(force=True, timeout_second=10),
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        assert run_to_completion(manager, fleet, policy, max_cycles=30)
+        remaining = [p["metadata"]["name"] for p in cluster.list("Pod", namespace="ml")]
+        assert remaining == ["job"]  # deletable evicted, finished job left
+
+    def test_validation_gate(self, cluster, fleet):
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster).with_validation_enabled("app=validator")
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        for _ in range(10):
+            reconcile(manager, fleet, policy)
+        # no validator pod yet → parked in validation-required
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_VALIDATION_REQUIRED
+        vpod = make_pod("validator", NAMESPACE, "n1", labels={"app": "validator"})
+        vpod["status"]["containerStatuses"] = [{"name": "v", "ready": True}]
+        cluster.create(vpod)
+        assert run_to_completion(manager, fleet, policy)
+
+    def test_failing_driver_pod_goes_failed_then_self_heals(
+        self, cluster, fleet
+    ):
+        fleet.add_node("n1", pod_hash="rev1", pod_ready=False, restart_count=11)
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(auto_upgrade=True)  # drain disabled
+        for _ in range(6):
+            reconcile(manager, fleet, policy, settle=True)
+            if fleet.node_state("n1") == consts.UPGRADE_STATE_FAILED:
+                break
+        # restart loop: recreated pod also arrives failing
+        pods = cluster.list("Pod", namespace=NAMESPACE)
+        for p in pods:
+            p["status"]["containerStatuses"][0].update(
+                {"ready": False, "restartCount": 11}
+            )
+            cluster.update(p)
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_FAILED
+        # now the pod comes up healthy at the new revision → self-heal
+        for p in cluster.list("Pod", namespace=NAMESPACE):
+            p["status"]["containerStatuses"][0].update(
+                {"ready": True, "restartCount": 0}
+            )
+            p["metadata"]["labels"]["controller-revision-hash"] = "rev2"
+            cluster.update(p)
+        assert run_to_completion(manager, fleet, policy)
+
+
+class TestThrottleMatrix:
+    """Reference: upgrade_state_test.go:294-613."""
+
+    @pytest.mark.parametrize(
+        "max_parallel,max_unavailable,expect_started",
+        [
+            (1, None, 1),
+            (2, None, 2),
+            (4, None, 4),
+            (0, None, 8),          # 0 = unlimited
+            (8, 2, 2),             # absolute maxUnavailable caps
+            (8, "25%", 2),         # 25% of 8
+            (8, "50%", 4),
+            (0, "25%", 2),         # unlimited parallel still capped
+            (3, "100%", 3),
+        ],
+    )
+    def test_slots(self, cluster, fleet, max_parallel, max_unavailable, expect_started):
+        for i in range(8):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=max_parallel,
+            max_unavailable=(
+                IntOrString(max_unavailable) if max_unavailable is not None else None
+            ),
+        )
+        # cycle 1: classification; cycle 2: throttle admits
+        reconcile(manager, fleet, policy, cycles=2)
+        states = fleet.states()
+        started = [
+            n
+            for n, s in states.items()
+            if s not in ("", consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        ]
+        assert len(started) == expect_started
+
+    def test_precordoned_nodes_bypass_throttle(self, cluster, fleet):
+        for i in range(4):
+            fleet.add_node(f"n{i}", pod_hash="rev1", unschedulable=(i < 2))
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString("100%"),
+        )
+        reconcile(manager, fleet, policy, cycles=2)
+        states = fleet.states()
+        started = {
+            n
+            for n, s in states.items()
+            if s not in ("", consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        }
+        # the two pre-cordoned nodes progress regardless of the 1-slot limit
+        assert {"n0", "n1"} <= started
+
+    def test_unavailable_nodes_consume_budget(self, cluster, fleet):
+        fleet.add_node("sick", pod_hash="rev1", ready=False)
+        for i in range(3):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString(1),  # the sick node eats the budget
+        )
+        reconcile(manager, fleet, policy, cycles=2)
+        healthy_started = [
+            n
+            for n, s in fleet.states().items()
+            if n != "sick" and s not in ("", consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        ]
+        assert healthy_started == []
+
+    def test_garbage_state_label_does_not_leak_slots(self, cluster, fleet):
+        # Regression: a corrupted state label must not permanently consume
+        # maxParallelUpgrades budget and stall the rollout.
+        fleet.add_node("corrupt", pod_hash="rev1")
+        cluster.patch(
+            "Node",
+            "corrupt",
+            {
+                "metadata": {
+                    "labels": {util.get_upgrade_state_label_key(): "some-garbage"}
+                }
+            },
+        )
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString("100%"),
+        )
+        reconcile(manager, fleet, policy, cycles=2)
+        assert fleet.node_state("n1") not in (
+            "",
+            consts.UPGRADE_STATE_UPGRADE_REQUIRED,
+        )
+
+    def test_prefix_overlapping_daemonset_revisions_isolated(self, cluster):
+        from k8s_operator_libs_tpu.cluster.objects import (
+            make_controller_revision,
+            make_daemonset,
+        )
+        from k8s_operator_libs_tpu.upgrade.pod_manager import PodManager
+
+        ds_a = cluster.create(make_daemonset("tpu-runtime", NAMESPACE))
+        ds_b = cluster.create(make_daemonset("tpu-runtime-v2", NAMESPACE))
+        cluster.create(make_controller_revision(ds_a, 1, "aaa"))
+        cluster.create(make_controller_revision(ds_b, 9, "zzz"))
+        mgr = PodManager(cluster, provider=None)
+        assert mgr.get_daemonset_controller_revision_hash(ds_a) == "aaa"
+        assert mgr.get_daemonset_controller_revision_hash(ds_b) == "zzz"
+
+    def test_skip_label_excludes_node(self, cluster, fleet):
+        fleet.add_node(
+            "skipme",
+            pod_hash="rev1",
+            labels={util.get_upgrade_skip_node_label_key(): "true"},
+        )
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(auto_upgrade=True, max_parallel_upgrades=8)
+        reconcile(manager, fleet, policy, cycles=2)
+        assert fleet.node_state("skipme") == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+
+
+class TestSliceAwareThrottle:
+    """TPU-native: unavailability counted in slice domains (SURVEY §7.4)."""
+
+    SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
+
+    def _fleet_with_slices(self, cluster, fleet, slices=2, hosts_per_slice=4):
+        for s in range(slices):
+            for h in range(hosts_per_slice):
+                fleet.add_node(
+                    f"slice{s}-host{h}",
+                    pod_hash="rev1",
+                    labels={self.SLICE_KEY: f"slice-{s}"},
+                )
+        fleet.publish_new_revision("rev2")
+
+    def test_whole_slice_coscheduled_as_one_slot(self, cluster, fleet):
+        self._fleet_with_slices(cluster, fleet, slices=2, hosts_per_slice=4)
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString("50%"),
+            slice_aware=True,
+        )
+        reconcile(manager, fleet, policy, cycles=2)
+        states = fleet.states()
+        started = {
+            n
+            for n, s in states.items()
+            if s not in ("", consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        }
+        # exactly one whole slice (4 hosts), not one host
+        assert len(started) == 4
+        slices_started = {n.split("-")[0] for n in started}
+        assert len(slices_started) == 1
+
+    def test_node_mode_would_strand_slice_budget(self, cluster, fleet):
+        # Contrast case documenting the win: without slice_aware, 25% of 8
+        # nodes = 2 hosts from (potentially) the same slice, leaving the
+        # other slice untouched but the first slice half-broken.
+        self._fleet_with_slices(cluster, fleet, slices=2, hosts_per_slice=4)
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("25%"),
+            slice_aware=False,
+        )
+        reconcile(manager, fleet, policy, cycles=2)
+        started = [
+            n
+            for n, s in fleet.states().items()
+            if s not in ("", consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        ]
+        assert len(started) == 2  # half a slice — the failure mode
+
+    def test_slice_aware_full_rolling_upgrade(self, cluster, fleet):
+        self._fleet_with_slices(cluster, fleet, slices=3, hosts_per_slice=2)
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString("34%"),
+            slice_aware=True,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        assert run_to_completion(manager, fleet, policy, max_cycles=60)
+
+    def test_mixed_slice_and_singleton_nodes(self, cluster, fleet):
+        fleet.add_node(
+            "s0-h0", pod_hash="rev1", labels={self.SLICE_KEY: "s0"}
+        )
+        fleet.add_node(
+            "s0-h1", pod_hash="rev1", labels={self.SLICE_KEY: "s0"}
+        )
+        fleet.add_node("lonely", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString(1),  # one *domain*
+            slice_aware=True,
+        )
+        reconcile(manager, fleet, policy, cycles=2)
+        started = {
+            n
+            for n, s in fleet.states().items()
+            if s not in ("", consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        }
+        # exactly one domain started: either both s0 hosts or just lonely
+        assert started in ({"s0-h0", "s0-h1"}, {"lonely"})
