@@ -26,6 +26,16 @@ from .pod_manager import (
 )
 from .safe_driver_load_manager import SafeDriverLoadManager
 from .upgrade_inplace import InplaceNodeStateManager
+from .upgrade_requestor import (
+    DEFAULT_NODE_MAINTENANCE_NAME_PREFIX,
+    NodeMaintenanceUpgradeDisabledError,
+    RequestorNodeStateManager,
+    RequestorOptions,
+    condition_changed_predicate,
+    convert_policy_to_maintenance_spec,
+    get_requestor_opts_from_envs,
+    new_requestor_id_predicate,
+)
 from .upgrade_state import ClusterUpgradeStateManager, UpgradeStateError
 from .validation_manager import ValidationManager
 
@@ -49,6 +59,14 @@ __all__ = [
     "PodManagerError",
     "SafeDriverLoadManager",
     "InplaceNodeStateManager",
+    "DEFAULT_NODE_MAINTENANCE_NAME_PREFIX",
+    "NodeMaintenanceUpgradeDisabledError",
+    "RequestorNodeStateManager",
+    "RequestorOptions",
+    "condition_changed_predicate",
+    "convert_policy_to_maintenance_spec",
+    "get_requestor_opts_from_envs",
+    "new_requestor_id_predicate",
     "ClusterUpgradeStateManager",
     "UpgradeStateError",
     "ValidationManager",

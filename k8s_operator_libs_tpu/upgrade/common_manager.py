@@ -61,11 +61,13 @@ POD_RESTART_FAILURE_THRESHOLD = 10
 
 @dataclass
 class NodeUpgradeState:
-    """One node + its driver pod + owning DaemonSet (reference :56-66)."""
+    """One node + its driver pod + owning DaemonSet (reference :56-66);
+    requestor mode attaches the node's NodeMaintenance CR, if any."""
 
     node: JsonObj
     driver_pod: JsonObj
     driver_daemonset: Optional[JsonObj] = None
+    node_maintenance: Optional[JsonObj] = None
 
     def is_orphaned_pod(self) -> bool:
         """Reference: IsOrphanedPod — no owner references (:221-223)."""

@@ -302,7 +302,12 @@ class ClusterUpgradeStateManager:
         self, state: ClusterUpgradeState
     ) -> None:
         """Both processors run so nodes that started in-place finish
-        in-place after requestor mode is enabled (reference :311-325)."""
+        in-place after requestor mode is enabled (reference :311-325).
+        Order matters: in-place runs FIRST — it skips nodes carrying the
+        requestor-mode annotation, and the requestor then strips that
+        annotation; reversed, the in-place pass would see the annotation
+        already gone and uncordon a node the maintenance operator (or a
+        remaining shared requestor) still holds."""
+        self.inplace.process_uncordon_required_nodes(state)
         if self._use_maintenance_operator and self._requestor is not None:
             self._requestor.process_uncordon_required_nodes(state)
-        self.inplace.process_uncordon_required_nodes(state)

@@ -139,3 +139,63 @@ class Fleet:
             )
             for n in self.cluster.list("Node")
         }
+
+
+class FakeMaintenanceOperator:
+    """A stand-in external maintenance operator: picks up NodeMaintenance
+    CRs, cordons + drains the named node out-of-band, then reports the
+    Ready condition — the counterpart the requestor mode hands off to
+    (reference: Mellanox maintenance-operator; conditions consumed at
+    upgrade_requestor.go:416-452)."""
+
+    def __init__(self, cluster: InMemoryCluster, namespace: str = "default"):
+        self.cluster = cluster
+        self.namespace = namespace
+
+    FINALIZER = "maintenance.tpu.google.com/finalizer"
+
+    def reconcile(self) -> int:
+        from k8s_operator_libs_tpu.cluster.errors import NotFoundError
+
+        handled = 0
+        for nm in self.cluster.list("NodeMaintenance", namespace=self.namespace):
+            # Graceful-deletion arbitration: the requestor's delete is only a
+            # *request* (upgrade_requestor.go:241-246 "assuming maintenance OP
+            # will handle actual obj deletion"); the CR is released once no
+            # additional requestors remain.
+            if nm["metadata"].get("deletionTimestamp"):
+                if not (nm.get("spec") or {}).get("additionalRequestors"):
+                    nm["metadata"]["finalizers"] = []
+                    self.cluster.update(nm)
+                continue
+            conds = (nm.get("status") or {}).get("conditions") or []
+            if any(c.get("type") == "Ready" for c in conds):
+                continue
+            if self.FINALIZER not in (nm["metadata"].get("finalizers") or []):
+                nm["metadata"].setdefault("finalizers", []).append(self.FINALIZER)
+            node_name = (nm.get("spec") or {}).get("nodeName", "")
+            try:
+                self.cluster.patch(
+                    "Node", node_name, {"spec": {"unschedulable": True}}
+                )
+            except NotFoundError:
+                # node gone: still take ownership (finalizer) but no work
+                self.cluster.update(nm)
+                continue
+            # evict non-driver pods (crude out-of-band drain)
+            for pod in self.cluster.list("Pod"):
+                owners = (pod.get("metadata") or {}).get("ownerReferences") or []
+                is_ds = any(o.get("kind") == "DaemonSet" for o in owners)
+                if (pod.get("spec") or {}).get("nodeName") == node_name and not is_ds:
+                    self.cluster.delete(
+                        "Pod",
+                        pod["metadata"]["name"],
+                        pod["metadata"].get("namespace", ""),
+                    )
+            nm.setdefault("status", {}).setdefault("conditions", []).append(
+                {"type": "Ready", "status": "True", "reason": "Ready"}
+            )
+            self.cluster.update(nm)
+            handled += 1
+        return handled
+
