@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Benchmark: rolling-upgrade throughput of the orchestration state machine.
+
+The reference publishes no benchmark numbers (BASELINE.md); its nearest
+operational proxy is **nodes upgraded per minute** against a local cluster
+(BASELINE.json).  This bench drives the full state machine — BuildState /
+ApplyState reconcile cycles, informer-cache visibility waits, concurrent
+drain workers, DaemonSet pod recreation — over a simulated 48-node fleet
+(12 four-host TPU slices) on the in-memory apiserver with a realistic
+informer lag, twice:
+
+* **baseline config** = the reference's defaults (maxParallelUpgrades=1,
+  maxUnavailable=25%, node-at-a-time semantics);
+* **tuned config**    = this framework's TPU mode (slice-aware domains,
+  maxParallelUpgrades=0 i.e. bounded only by slice budget).
+
+Prints ONE JSON line: ``metric`` is the tuned nodes/min; ``vs_baseline``
+is the speedup over the reference-default configuration on the identical
+fleet and substrate.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+
+logging.disable(logging.WARNING)
+
+from k8s_operator_libs_tpu.api import DrainSpec, IntOrString, UpgradePolicySpec
+from k8s_operator_libs_tpu.cluster import InformerCache, InMemoryCluster
+from k8s_operator_libs_tpu.cluster.objects import get_label
+from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager, consts, util
+
+from harness import DRIVER_LABELS, NAMESPACE, Fleet
+
+SLICES = 12
+HOSTS_PER_SLICE = 4
+N_NODES = SLICES * HOSTS_PER_SLICE
+INFORMER_LAG_S = 0.02
+
+
+def build_fleet(cluster: InMemoryCluster) -> Fleet:
+    fleet = Fleet(cluster, revision_hash="rev1")
+    for s in range(SLICES):
+        for h in range(HOSTS_PER_SLICE):
+            fleet.add_node(
+                f"slice{s:02d}-host{h}",
+                labels={consts.SLICE_ID_LABEL_KEYS[0]: f"slice-{s:02d}"},
+            )
+    fleet.publish_new_revision("rev2")
+    return fleet
+
+
+def run_rollout(policy: UpgradePolicySpec, max_cycles: int = 500) -> float:
+    """Returns wall-clock seconds for the whole fleet to reach upgrade-done."""
+    cluster = InMemoryCluster()
+    fleet = build_fleet(cluster)
+    cache = InformerCache(cluster, lag_seconds=INFORMER_LAG_S)
+    manager = ClusterUpgradeStateManager(
+        cluster,
+        cache=cache,
+        cache_sync_timeout_seconds=5.0,
+        cache_sync_poll_seconds=0.005,
+    )
+    label_key = util.get_upgrade_state_label_key()
+    t0 = time.monotonic()
+    for _ in range(max_cycles):
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        manager.apply_state(state, policy)
+        manager.drain_manager.wait_idle(30.0)
+        manager.pod_manager.wait_idle(30.0)
+        fleet.reconcile_daemonset()
+        states = {
+            get_label(n, label_key) for n in cluster.list("Node")
+        }
+        if states == {consts.UPGRADE_STATE_DONE}:
+            return time.monotonic() - t0
+    raise RuntimeError("rollout did not converge")
+
+
+def main() -> None:
+    util.set_component_name("tpu-runtime")
+    drain = DrainSpec(enable=True, force=True, timeout_second=60)
+
+    baseline_policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,          # reference default (upgrade_spec.go:36-38)
+        max_unavailable=IntOrString("25%"),  # reference default (:42-45)
+        drain_spec=drain,
+    )
+    tuned_policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,          # bounded by the slice budget only
+        max_unavailable=IntOrString("25%"),
+        slice_aware=True,
+        drain_spec=drain,
+    )
+
+    baseline_s = run_rollout(baseline_policy)
+    tuned_s = run_rollout(tuned_policy)
+
+    baseline_rate = N_NODES / (baseline_s / 60.0)
+    tuned_rate = N_NODES / (tuned_s / 60.0)
+
+    print(
+        json.dumps(
+            {
+                "metric": "nodes_upgraded_per_min",
+                "value": round(tuned_rate, 2),
+                "unit": "nodes/min",
+                "vs_baseline": round(tuned_rate / baseline_rate, 3),
+                "detail": {
+                    "fleet": f"{SLICES}x{HOSTS_PER_SLICE}-host slices",
+                    "baseline_config_nodes_per_min": round(baseline_rate, 2),
+                    "baseline_wall_s": round(baseline_s, 2),
+                    "tuned_wall_s": round(tuned_s, 2),
+                    "informer_lag_s": INFORMER_LAG_S,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

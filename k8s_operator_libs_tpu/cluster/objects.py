@@ -238,7 +238,9 @@ def make_pod(
             "conditions": [
                 {"type": "Ready", "status": "True" if ready else "False"}
             ],
-            "containerStatuses": [{"name": "main", "restartCount": restart_count}],
+            "containerStatuses": [
+                {"name": "main", "restartCount": restart_count, "ready": ready}
+            ],
         },
     }
     if owner is not None:

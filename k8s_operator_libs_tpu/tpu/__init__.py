@@ -1,8 +1,13 @@
 """TPU-native extensions: slice topology, checkpoint-drain, demo workload.
 
-Modules land incrementally:
-
-* ``topology``        — slice/failure-domain grouping for the throttle
-* ``drain_handshake`` — checkpoint-on-drain annotation protocol
-* ``workload``        — demo SPMD JAX trainer integrating both
+* :mod:`.topology`        — slice/failure-domain grouping for the throttle
+* :mod:`.drain_handshake` — checkpoint-on-drain annotation protocol
+* :mod:`.workload`        — demo SPMD JAX trainer integrating both
+  (imported lazily: ``from k8s_operator_libs_tpu.tpu import workload`` —
+  keeping jax out of the control-plane import path)
 """
+
+from . import topology
+from .drain_handshake import CheckpointDrainGate, DrainSignalWatcher
+
+__all__ = ["topology", "CheckpointDrainGate", "DrainSignalWatcher"]

@@ -1,0 +1,156 @@
+"""Checkpoint-on-drain handshake — the TPU analog of safe-driver-load,
+in reverse.
+
+The reference's safe-load handshake (safe_driver_load_manager.go:51-71 +
+docs/automatic-ofed-upgrade.md:43-66) blocks a *starting* driver until the
+node is quiesced.  On TPU fleets the mirrored problem is at *drain* time:
+evicting a JAX launcher kills an SPMD step mid-flight, losing everything
+since the last checkpoint.  This module implements the two-party protocol
+(SURVEY.md §7 step 6) over one node annotation
+(``tpu.google.com/<component>-pre-drain-checkpoint``):
+
+orchestrator (drain side)                 workload (JAX launcher side)
+--------------------------                ----------------------------
+cordon node
+annotation = "requested"       ──────▶    watcher sees "requested"
+block (≤ timeout)                         saves orbax checkpoint
+                               ◀──────    annotation = "done"
+clear annotation, evict pods
+
+On timeout the drain proceeds anyway (availability beats durability —
+the checkpoint is an optimization, not a correctness gate), mirroring how
+kubectl drain's own timeout fails open into eviction.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional
+
+from ..api.upgrade_spec import PreDrainCheckpointSpec
+from ..cluster.errors import NotFoundError
+from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.objects import get_annotation, name_of
+from ..upgrade import consts, util
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_POLL_SECONDS = 0.05
+
+
+class CheckpointDrainGate:
+    """Orchestrator side — plugs into :class:`~..upgrade.drain_manager.
+    DrainManager` as its ``pre_drain_gate`` (runs after cordon, before
+    eviction)."""
+
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        spec: Optional[PreDrainCheckpointSpec] = None,
+        poll_seconds: float = DEFAULT_POLL_SECONDS,
+    ) -> None:
+        self._cluster = cluster
+        self.spec = spec or PreDrainCheckpointSpec()
+        self._poll = poll_seconds
+
+    def wait_for_checkpoint(self, node: JsonObj) -> None:
+        if not self.spec.enable:
+            return
+        name = name_of(node)
+        key = util.get_pre_drain_checkpoint_annotation_key()
+        self._cluster.patch(
+            "Node",
+            name,
+            {
+                "metadata": {
+                    "annotations": {key: consts.PRE_DRAIN_CHECKPOINT_REQUESTED}
+                }
+            },
+        )
+        deadline = (
+            time.monotonic() + self.spec.timeout_second
+            if self.spec.timeout_second > 0
+            else None
+        )
+        while True:
+            try:
+                current = self._cluster.get("Node", name)
+            except NotFoundError:
+                return
+            if (
+                get_annotation(current, key)
+                == consts.PRE_DRAIN_CHECKPOINT_DONE
+            ):
+                logger.info("node %s checkpoint acknowledged before drain", name)
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                logger.warning(
+                    "node %s checkpoint wait timed out after %ss; "
+                    "draining anyway",
+                    name,
+                    self.spec.timeout_second,
+                )
+                break
+            time.sleep(self._poll)
+        # Clear the handshake so the next upgrade cycle starts fresh.
+        self._cluster.patch(
+            "Node", name, {"metadata": {"annotations": {key: None}}}
+        )
+
+
+class DrainSignalWatcher:
+    """Workload side — polled by the JAX launcher between training steps.
+
+    In production the launcher reads its node's annotations through the
+    kube API (or a downward-API file); any zero-argument reader callable
+    can be injected.  :meth:`check_and_acknowledge` is the one-call
+    integration point: returns True (after running ``on_checkpoint`` and
+    acknowledging) when a checkpoint was requested.
+    """
+
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        node_name: str,
+        read_annotation: Optional[Callable[[], str]] = None,
+    ) -> None:
+        self._cluster = cluster
+        self.node_name = node_name
+        self._key = util.get_pre_drain_checkpoint_annotation_key()
+        self._read = read_annotation or self._read_from_cluster
+
+    def _read_from_cluster(self) -> str:
+        try:
+            node = self._cluster.get("Node", self.node_name)
+        except NotFoundError:
+            return ""
+        return get_annotation(node, self._key)
+
+    def checkpoint_requested(self) -> bool:
+        return self._read() == consts.PRE_DRAIN_CHECKPOINT_REQUESTED
+
+    def acknowledge(self) -> None:
+        """Report checkpoint-saved back to the orchestrator."""
+        self._cluster.patch(
+            "Node",
+            self.node_name,
+            {
+                "metadata": {
+                    "annotations": {
+                        self._key: consts.PRE_DRAIN_CHECKPOINT_DONE
+                    }
+                }
+            },
+        )
+
+    def check_and_acknowledge(
+        self, on_checkpoint: Callable[[], None]
+    ) -> bool:
+        """If a checkpoint was requested: run ``on_checkpoint`` (e.g. an
+        orbax save), acknowledge, and return True."""
+        if not self.checkpoint_requested():
+            return False
+        on_checkpoint()
+        self.acknowledge()
+        return True

@@ -1,0 +1,281 @@
+"""Demo SPMD JAX workload — the training job the orchestrator drains.
+
+The reference library orchestrates *around* workloads and never computes
+(SURVEY.md header); this module supplies the TPU-side counterpart the
+TPU-native features integrate with:
+
+* a small causal-transformer LM trained with a **jit-compiled SPMD train
+  step** over a ``jax.sharding.Mesh`` with ``data`` (batch) and ``model``
+  (tensor) axes — NamedSharding param/batch layouts, XLA inserting the
+  collectives;
+* **orbax** checkpoint save/restore;
+* a :class:`CheckpointingTrainer` loop that polls the
+  :class:`~.drain_handshake.DrainSignalWatcher` between steps and saves a
+  checkpoint before acknowledging the orchestrator's drain — so a slice
+  upgrade costs at most one step of lost work.
+
+TPU notes: matmul-heavy (MXU-friendly) layers, static shapes under jit,
+``dtype`` switchable to bfloat16; the mesh layout keeps the ``model``
+axis innermost so tensor-parallel collectives ride ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 128
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    max_seq_len: int = 64
+    dtype: Any = jnp.float32  # bfloat16 on real TPU
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block with causal self-attention."""
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_attn")(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=cfg.n_heads,
+            dtype=cfg.dtype,
+            qkv_features=cfg.d_model,
+            deterministic=True,
+            name="attn",
+        )(h, mask=nn.make_causal_mask(jnp.ones(h.shape[:2], dtype=bool)))
+        x = x + h
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_mlp")(x)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="mlp_up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_down")(h)
+        return x + h
+
+
+class TinyLM(nn.Module):
+    """Causal LM: embed → blocks → LN → logits."""
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        x = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed"
+        )(tokens)
+        pos = nn.Embed(
+            cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, name="pos_embed"
+        )(jnp.arange(tokens.shape[1])[None, :])
+        x = x + pos
+        for i in range(cfg.n_layers):
+            x = Block(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        return nn.Dense(cfg.vocab_size, dtype=cfg.dtype, name="lm_head")(x)
+
+
+# ----------------------------------------------------------------- sharding
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    dp: Optional[int] = None,
+    tp: Optional[int] = None,
+) -> Mesh:
+    """A (data, model) mesh.  Defaults: all devices, tp = min(n, d_model
+    divisor 2) — callers pick explicit dp×tp for real topologies."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if dp is None or tp is None:
+        tp = tp or (2 if n % 2 == 0 and n > 1 else 1)
+        dp = dp or n // tp
+    if dp * tp != n:
+        raise ValueError(f"dp({dp}) * tp({tp}) != devices({n})")
+    dev_array = np.array(devices[:n]).reshape(dp, tp)
+    return Mesh(dev_array, axis_names=("data", "model"))
+
+
+def param_partition_spec(path: Tuple[str, ...], leaf: jax.Array) -> P:
+    """Path-based tensor-parallel layout: up-projections and qkv split
+    their output dim over ``model``; down/out projections split their
+    input dim; embeddings split the feature dim; everything else (biases,
+    layernorm scales) replicates."""
+    names = "/".join(str(p) for p in path)
+    if leaf.ndim < 2:
+        return P()
+    if "mlp_up" in names or ("attn" in names and "out" not in names):
+        return P(None, "model") if leaf.ndim == 2 else P(None, None, "model")
+    if "mlp_down" in names or ("attn" in names and "out" in names):
+        return P("model", None) if leaf.ndim == 2 else P(None, "model", None)
+    if "embed" in names or "lm_head" in names:
+        return P(None, "model")
+    return P()
+
+
+def shard_params(params, mesh: Mesh):
+    """Place a param tree onto the mesh per :func:`param_partition_spec`."""
+
+    def place(path, leaf):
+        spec = param_partition_spec(tuple(k.key for k in path), leaf)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+# ------------------------------------------------------------- train state
+
+
+def create_train_state(
+    config: ModelConfig, mesh: Optional[Mesh] = None, seed: int = 0
+):
+    """(model, params, opt_state) with params optionally mesh-placed."""
+    import optax
+
+    model = TinyLM(config)
+    rng = jax.random.PRNGKey(seed)
+    tokens = jnp.zeros((1, config.max_seq_len), dtype=jnp.int32)
+    params = model.init(rng, tokens)["params"]
+    if mesh is not None:
+        params = shard_params(params, mesh)
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+    return model, params, tx, opt_state
+
+
+def loss_fn(model: TinyLM, params, tokens):
+    """Next-token cross-entropy (teacher-forced causal LM)."""
+    logits = model.apply({"params": params}, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_train_step(model: TinyLM, tx, mesh: Optional[Mesh] = None):
+    """A jit-compiled SPMD train step.  Batch is sharded over ``data``;
+    param/optimizer layouts follow their NamedShardings; XLA inserts the
+    psum for the data-parallel gradient reduction and the tensor-parallel
+    collectives."""
+
+    import optax
+
+    def step(params, opt_state, tokens):
+        if mesh is not None:
+            tokens = jax.lax.with_sharding_constraint(
+                tokens, NamedSharding(mesh, P("data", None))
+            )
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(model, p, tokens)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def make_batch(config: ModelConfig, batch_size: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.integers(
+            0, config.vocab_size, size=(batch_size, config.max_seq_len)
+        ),
+        dtype=jnp.int32,
+    )
+
+
+# ------------------------------------------------------------ orbax wiring
+
+
+def save_checkpoint(directory: str, step: int, params, opt_state) -> None:
+    """Orbax save of the full training state."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    path = f"{directory}/step_{step}"
+    ckptr.save(
+        path,
+        {
+            "step": step,
+            "params": jax.device_get(params),
+            "opt_state": jax.device_get(opt_state),
+        },
+        force=True,
+    )
+    ckptr.wait_until_finished()
+
+
+def restore_checkpoint(directory: str, step: int, like=None) -> Dict[str, Any]:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(f"{directory}/step_{step}", target=like)
+
+
+class CheckpointingTrainer:
+    """The drain-aware training loop.
+
+    Runs jitted steps; between steps polls the drain watcher — when the
+    orchestrator requests a pre-drain checkpoint the trainer saves via
+    orbax, acknowledges, and (by default) stops cleanly so the eviction
+    finds an idle process.
+    """
+
+    def __init__(
+        self,
+        config: ModelConfig,
+        checkpoint_dir: str,
+        watcher=None,
+        mesh: Optional[Mesh] = None,
+        batch_size: int = 8,
+        stop_on_drain: bool = True,
+    ) -> None:
+        self.config = config
+        self.checkpoint_dir = checkpoint_dir
+        self.watcher = watcher
+        self.mesh = mesh
+        self.batch_size = batch_size
+        self.stop_on_drain = stop_on_drain
+        self.model, self.params, self.tx, self.opt_state = create_train_state(
+            config, mesh
+        )
+        self.step_fn = make_train_step(self.model, self.tx, mesh)
+        self.step = 0
+        self.drained = False
+        self.losses: list = []
+
+    def save(self) -> None:
+        save_checkpoint(
+            self.checkpoint_dir, self.step, self.params, self.opt_state
+        )
+
+    def run(self, n_steps: int) -> int:
+        """Train up to *n_steps*; returns the number of steps completed
+        (fewer if a drain checkpoint stopped the loop)."""
+        for _ in range(n_steps):
+            if self.watcher is not None and self.watcher.check_and_acknowledge(
+                self.save
+            ):
+                self.drained = True
+                if self.stop_on_drain:
+                    break
+            batch = make_batch(self.config, self.batch_size, seed=self.step)
+            self.params, self.opt_state, loss = self.step_fn(
+                self.params, self.opt_state, batch
+            )
+            self.losses.append(float(loss))
+            self.step += 1
+        return self.step

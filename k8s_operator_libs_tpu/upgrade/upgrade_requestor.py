@@ -262,6 +262,12 @@ class RequestorNodeStateManager:
         nm = node_state.node_maintenance
         if nm is None:
             return
+        # Re-fetch for a fresh resourceVersion — the snapshot copy may be
+        # stale if the maintenance operator touched the CR mid-reconcile,
+        # which would make the rv-guarded patch below conflict spuriously.
+        fresh = self.get_node_maintenance_obj(name_of(node_state.node))
+        if fresh is not None:
+            nm = node_state.node_maintenance = fresh
         spec = nm.get("spec") or {}
         if spec.get("requestorID") == self.opts.requestor_id:
             self.delete_node_maintenance(node_state)
@@ -352,6 +358,13 @@ class RequestorNodeStateManager:
             node = node_state.node
             if not util.is_node_in_requestor_mode(node):
                 continue  # in-place flow finishes this node
+            # CR cleanup runs FIRST (deviation from the reference's order,
+            # :462-485): if the rv-guarded membership patch conflicts, the
+            # node stays in uncordon-required and the next reconcile
+            # retries — finalizing the node first would leak this
+            # requestor's additionalRequestors membership forever, since no
+            # later state revisits it.
+            self.delete_or_update_node_maintenance(node_state)
             common.provider.change_node_upgrade_state(
                 node, consts.UPGRADE_STATE_DONE
             )
@@ -360,7 +373,6 @@ class RequestorNodeStateManager:
                 util.get_upgrade_requestor_mode_annotation_key(),
                 consts.NULL_STRING,
             )
-            self.delete_or_update_node_maintenance(node_state)
 
 
 # ------------------------------------------------------------- predicates

@@ -11,12 +11,27 @@ before jax is first imported anywhere in the process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU backend for tests even when a real TPU is attached — the
+# suite validates multi-chip sharding on a virtual 8-device mesh.  The
+# environment may have already imported jax (e.g. a PJRT plugin hook in
+# sitecustomize), so updating os.environ alone is not enough: the config
+# must be updated on the already-imported module, before any backend is
+# initialized by a first jax.devices()/jit call.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+try:
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    # jax is an optional [tpu] extra; the control-plane suite must still
+    # collect and run without it (test_tpu_integration imports jax lazily).
+    pass
 
 import pytest
 

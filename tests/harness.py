@@ -76,7 +76,6 @@ class Fleet:
             ready=pod_ready,
             restart_count=restart_count,
         )
-        pod["status"]["containerStatuses"][0]["ready"] = pod_ready
         self.cluster.create(pod)
         self._bump_desired(+1)
         return node
@@ -121,7 +120,6 @@ class Fleet:
                 revision_hash=self.revision_hash,
                 ready=True,
             )
-            pod["status"]["containerStatuses"][0]["ready"] = True
             self.cluster.create(pod)
             created += 1
         return created
