@@ -34,7 +34,6 @@ logging.disable(logging.WARNING)
 
 from k8s_operator_libs_tpu.api import DrainSpec, IntOrString, UpgradePolicySpec
 from k8s_operator_libs_tpu.cluster import InformerCache, InMemoryCluster
-from k8s_operator_libs_tpu.cluster.objects import get_label
 from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager, consts, util
 
 from harness import DRIVER_LABELS, NAMESPACE, Fleet
@@ -68,7 +67,6 @@ def run_rollout(policy: UpgradePolicySpec, max_cycles: int = 500) -> float:
         cache_sync_timeout_seconds=5.0,
         cache_sync_poll_seconds=0.005,
     )
-    label_key = util.get_upgrade_state_label_key()
     t0 = time.monotonic()
     for _ in range(max_cycles):
         state = manager.build_state(NAMESPACE, DRIVER_LABELS)
@@ -76,10 +74,7 @@ def run_rollout(policy: UpgradePolicySpec, max_cycles: int = 500) -> float:
         manager.drain_manager.wait_idle(30.0)
         manager.pod_manager.wait_idle(30.0)
         fleet.reconcile_daemonset()
-        states = {
-            get_label(n, label_key) for n in cluster.list("Node")
-        }
-        if states == {consts.UPGRADE_STATE_DONE}:
+        if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
             return time.monotonic() - t0
     raise RuntimeError("rollout did not converge")
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import logging
 import time
+import uuid
 from typing import Callable, Optional
 
 from ..api.upgrade_spec import PreDrainCheckpointSpec
@@ -59,14 +60,15 @@ class CheckpointDrainGate:
             return
         name = name_of(node)
         key = util.get_pre_drain_checkpoint_annotation_key()
+        # Per-cycle token: the ack must echo it, so a laggard "done" from a
+        # previous timed-out cycle can never satisfy this cycle's gate.
+        token = uuid.uuid4().hex[:12]
+        requested = f"{consts.PRE_DRAIN_CHECKPOINT_REQUESTED}:{token}"
+        expected_ack = f"{consts.PRE_DRAIN_CHECKPOINT_DONE}:{token}"
         self._cluster.patch(
             "Node",
             name,
-            {
-                "metadata": {
-                    "annotations": {key: consts.PRE_DRAIN_CHECKPOINT_REQUESTED}
-                }
-            },
+            {"metadata": {"annotations": {key: requested}}},
         )
         deadline = (
             time.monotonic() + self.spec.timeout_second
@@ -78,10 +80,7 @@ class CheckpointDrainGate:
                 current = self._cluster.get("Node", name)
             except NotFoundError:
                 return
-            if (
-                get_annotation(current, key)
-                == consts.PRE_DRAIN_CHECKPOINT_DONE
-            ):
+            if get_annotation(current, key) == expected_ack:
                 logger.info("node %s checkpoint acknowledged before drain", name)
                 break
             if deadline is not None and time.monotonic() >= deadline:
@@ -128,20 +127,22 @@ class DrainSignalWatcher:
         return get_annotation(node, self._key)
 
     def checkpoint_requested(self) -> bool:
-        return self._read() == consts.PRE_DRAIN_CHECKPOINT_REQUESTED
+        value = self._read()
+        return value.split(":", 1)[0] == consts.PRE_DRAIN_CHECKPOINT_REQUESTED
 
     def acknowledge(self) -> None:
-        """Report checkpoint-saved back to the orchestrator."""
+        """Report checkpoint-saved back to the orchestrator, echoing the
+        request's per-cycle token (if any) so the gate can reject acks
+        from earlier cycles."""
+        value = self._read()
+        parts = value.split(":", 1)
+        ack = consts.PRE_DRAIN_CHECKPOINT_DONE
+        if len(parts) == 2 and parts[0] == consts.PRE_DRAIN_CHECKPOINT_REQUESTED:
+            ack = f"{consts.PRE_DRAIN_CHECKPOINT_DONE}:{parts[1]}"
         self._cluster.patch(
             "Node",
             self.node_name,
-            {
-                "metadata": {
-                    "annotations": {
-                        self._key: consts.PRE_DRAIN_CHECKPOINT_DONE
-                    }
-                }
-            },
+            {"metadata": {"annotations": {self._key: ack}}},
         )
 
     def check_and_acknowledge(

@@ -47,8 +47,9 @@ UPGRADE_STATE_FAILED = "upgrade-failed"
 #: (requestor mode) NodeMaintenance CR created; external operator is working.
 UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED = "node-maintenance-required"
 #: (requestor mode) declared but not yet wired in the reference either —
-#: requestor transitions straight node-maintenance-required → pod-restart-required
-#: (reference TODO at upgrade_state.go:249-250; consts.go:70).
+#: requestor transitions straight node-maintenance-required →
+#: pod-restart-required (reference notes the future rename at
+#: upgrade_state.go:249-250; consts.go:70).
 UPGRADE_STATE_POST_MAINTENANCE_REQUIRED = "post-maintenance-required"
 
 #: Every known state value (including the empty "unknown" state).

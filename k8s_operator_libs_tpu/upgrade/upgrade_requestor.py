@@ -266,8 +266,11 @@ class RequestorNodeStateManager:
         # stale if the maintenance operator touched the CR mid-reconcile,
         # which would make the rv-guarded patch below conflict spuriously.
         fresh = self.get_node_maintenance_obj(name_of(node_state.node))
-        if fresh is not None:
-            nm = node_state.node_maintenance = fresh
+        if fresh is None:
+            # CR vanished since the snapshot — no membership left to clean.
+            node_state.node_maintenance = None
+            return
+        nm = node_state.node_maintenance = fresh
         spec = nm.get("spec") or {}
         if spec.get("requestorID") == self.opts.requestor_id:
             self.delete_node_maintenance(node_state)

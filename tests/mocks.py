@@ -1,0 +1,135 @@
+"""Hand-rolled manager doubles — the analog of the reference's mockery-
+generated mocks (pkg/upgrade/mocks/, C17).
+
+The reference's state-machine tests exercise real C1–C4 logic over a real
+API server with *mocked* node-op managers whose handlers mutate the node
+in memory instead of patching the API (upgrade_suit_test.go:114-182).
+These doubles reproduce that pattern: every call is recorded for
+assertion, and behavior is overridable per-test via small lambdas.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclass
+class CallLog:
+    calls: List[Tuple[str, tuple, dict]] = field(default_factory=list)
+
+    def record(self, name: str, *args: Any, **kwargs: Any) -> None:
+        self.calls.append((name, args, kwargs))
+
+    def names(self) -> List[str]:
+        return [c[0] for c in self.calls]
+
+    def count(self, name: str) -> int:
+        return sum(1 for c in self.calls if c[0] == name)
+
+
+class MockNodeUpgradeStateProvider:
+    """In-memory state provider: writes go straight into the node dicts."""
+
+    def __init__(self) -> None:
+        self.log = CallLog()
+
+    def get_node(self, name: str):
+        raise NotImplementedError("mock provider has no cluster")
+
+    def change_node_upgrade_state(self, node, new_state: str) -> None:
+        from k8s_operator_libs_tpu.upgrade import util
+
+        self.log.record("change_node_upgrade_state", node, new_state)
+        key = util.get_upgrade_state_label_key()
+        labels = node.setdefault("metadata", {}).setdefault("labels", {})
+        if new_state == "":
+            labels.pop(key, None)
+        else:
+            labels[key] = new_state
+
+    def change_node_upgrade_annotation(self, node, key: str, value: str) -> None:
+        self.log.record("change_node_upgrade_annotation", node, key, value)
+        anns = node.setdefault("metadata", {}).setdefault("annotations", {})
+        if value == "null":
+            anns.pop(key, None)
+        else:
+            anns[key] = value
+
+
+class MockCordonManager:
+    def __init__(self) -> None:
+        self.log = CallLog()
+
+    def cordon(self, node) -> None:
+        self.log.record("cordon", node)
+        node.setdefault("spec", {})["unschedulable"] = True
+
+    def uncordon(self, node) -> None:
+        self.log.record("uncordon", node)
+        node.setdefault("spec", {})["unschedulable"] = False
+
+
+class MockDrainManager:
+    def __init__(self, on_drain: Optional[Callable] = None) -> None:
+        self.log = CallLog()
+        self.on_drain = on_drain
+
+    def schedule_nodes_drain(self, config) -> None:
+        self.log.record("schedule_nodes_drain", config)
+        if self.on_drain is not None:
+            self.on_drain(config)
+
+
+class MockPodManager:
+    def __init__(self) -> None:
+        self.log = CallLog()
+        self.ds_hash: str = "rev1"
+        self.pod_hashes: Dict[str, str] = {}
+
+    # revision oracle -------------------------------------------------------
+    def get_pod_controller_revision_hash(self, pod) -> str:
+        name = (pod.get("metadata") or {}).get("name", "")
+        labels = (pod.get("metadata") or {}).get("labels") or {}
+        return self.pod_hashes.get(name) or labels.get(
+            "controller-revision-hash", ""
+        )
+
+    def get_daemonset_controller_revision_hash(self, ds) -> str:
+        return self.ds_hash
+
+    # scheduling ------------------------------------------------------------
+    def schedule_pod_eviction(self, config) -> None:
+        self.log.record("schedule_pod_eviction", config)
+
+    def schedule_pods_restart(self, pods) -> None:
+        self.log.record("schedule_pods_restart", pods)
+
+    def schedule_check_on_pod_completion(self, config) -> None:
+        self.log.record("schedule_check_on_pod_completion", config)
+
+    def set_pod_deletion_filter(self, f) -> None:
+        self.log.record("set_pod_deletion_filter", f)
+
+
+class MockValidationManager:
+    def __init__(self, result: bool = True) -> None:
+        self.log = CallLog()
+        self.result = result
+        self.pod_selector = ""
+
+    def validate(self, node) -> bool:
+        self.log.record("validate", node)
+        return self.result
+
+
+class MockSafeDriverLoadManager:
+    def __init__(self, waiting: bool = False) -> None:
+        self.log = CallLog()
+        self.waiting = waiting
+
+    def is_waiting_for_safe_driver_load(self, node) -> bool:
+        return self.waiting
+
+    def unblock_loading(self, node) -> None:
+        self.log.record("unblock_loading", node)

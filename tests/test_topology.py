@@ -1,0 +1,54 @@
+"""Unit tests for the slice-topology domain model (tpu/topology.py)."""
+
+from k8s_operator_libs_tpu.cluster.objects import make_node
+from k8s_operator_libs_tpu.tpu import topology
+from k8s_operator_libs_tpu.upgrade import consts
+
+SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
+GKE_KEY = consts.SLICE_ID_LABEL_KEYS[1]
+
+
+class TestDomains:
+    def test_slice_label_priority_order(self):
+        node = make_node("n1", labels={SLICE_KEY: "a", GKE_KEY: "b"})
+        assert topology.slice_id_of(node) == "a"  # first key wins
+
+    def test_gke_label_fallback(self):
+        node = make_node("n1", labels={GKE_KEY: "b"})
+        assert topology.slice_id_of(node) == "b"
+
+    def test_unlabeled_node_is_singleton_domain(self):
+        node = make_node("solo")
+        assert topology.slice_id_of(node) is None
+        assert topology.domain_of(node) == "node:solo"
+        assert topology.is_singleton_domain(topology.domain_of(node))
+
+    def test_group_by_domain(self):
+        nodes = [
+            make_node("a1", labels={SLICE_KEY: "s-a"}),
+            make_node("a2", labels={SLICE_KEY: "s-a"}),
+            make_node("b1", labels={SLICE_KEY: "s-b"}),
+            make_node("solo"),
+        ]
+        groups = topology.group_by_domain(nodes)
+        assert {k: len(v) for k, v in groups.items()} == {
+            "s-a": 2,
+            "s-b": 1,
+            "node:solo": 1,
+        }
+
+
+class TestUnavailability:
+    def test_cordoned_or_not_ready_is_unavailable(self):
+        assert topology.node_is_unavailable(make_node("n", unschedulable=True))
+        assert topology.node_is_unavailable(make_node("n", ready=False))
+        assert not topology.node_is_unavailable(make_node("n"))
+
+    def test_one_sick_host_poisons_whole_domain(self):
+        nodes = [
+            make_node("a1", labels={SLICE_KEY: "s-a"}, ready=False),
+            make_node("a2", labels={SLICE_KEY: "s-a"}),
+            make_node("b1", labels={SLICE_KEY: "s-b"}),
+        ]
+        assert topology.count_unavailable_domains(nodes) == 1
+        assert topology.count_domains(nodes) == 2

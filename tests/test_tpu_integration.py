@@ -80,6 +80,38 @@ class TestHandshakeProtocol:
         gate.wait_for_checkpoint(cluster.get("Node", "n1"))  # nobody acks
         assert time.monotonic() - t0 < 2.0  # proceeded after timeout
 
+    def test_stale_ack_from_previous_cycle_rejected(self, cluster):
+        """Regression: a laggard 'done' from a timed-out earlier cycle must
+        not satisfy a later cycle's gate (per-cycle token echo)."""
+        cluster.create(make_node("n1"))
+        key = util.get_pre_drain_checkpoint_annotation_key()
+        gate = CheckpointDrainGate(
+            cluster,
+            PreDrainCheckpointSpec(enable=True, timeout_second=0.3),
+            poll_seconds=0.01,
+        )
+        # a stale plain/foreign-token ack keeps landing on the node
+        stop = threading.Event()
+
+        def stale_acker():
+            while not stop.is_set():
+                cluster.patch(
+                    "Node",
+                    "n1",
+                    {"metadata": {"annotations": {key: "done:stale-token"}}},
+                )
+                time.sleep(0.02)
+
+        t = threading.Thread(target=stale_acker)
+        t.start()
+        t0 = time.monotonic()
+        gate.wait_for_checkpoint(cluster.get("Node", "n1"))
+        elapsed = time.monotonic() - t0
+        stop.set()
+        t.join()
+        # the gate never accepted the stale ack: it ran to its timeout
+        assert elapsed >= 0.3
+
     def test_disabled_gate_is_noop(self, cluster):
         cluster.create(make_node("n1"))
         gate = CheckpointDrainGate(
@@ -134,7 +166,7 @@ class TestHandshakeProtocol:
 class TestSpmdWorkload:
     @pytest.fixture(scope="class")
     def jax_bits(self):
-        import jax
+        jax = pytest.importorskip("jax")  # optional [tpu] extra
 
         from k8s_operator_libs_tpu.tpu import workload as wl
 

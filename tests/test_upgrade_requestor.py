@@ -327,6 +327,26 @@ class TestSharedRequestorProtocol:
         nm = requestor.get_node_maintenance_obj("n1")
         assert nm["spec"]["sliceId"] == "slice-7"
 
+    def test_stale_snapshot_of_deleted_cr_is_noop(self, cluster):
+        """Regression: the owner deleted the CR between BuildState and the
+        uncordon pass — the secondary's cleanup must no-op, not crash the
+        reconcile with NotFound."""
+        from k8s_operator_libs_tpu.upgrade.common_manager import NodeUpgradeState
+
+        nm = self._nm(cluster, owner="operator-a", additional=["operator-b"])
+        _manager, req_b = make_requestor_manager(
+            cluster, requestor_id="operator-b"
+        )
+        stale = req_b.get_node_maintenance_obj("n1")
+        cluster.delete("NodeMaintenance", nm["metadata"]["name"], "default")
+        ns = NodeUpgradeState(
+            node={"metadata": {"name": "n1"}},
+            driver_pod={},
+            node_maintenance=stale,
+        )
+        req_b.delete_or_update_node_maintenance(ns)  # must not raise
+        assert ns.node_maintenance is None
+
     def test_owner_delete_while_shared_is_graceful(self, cluster):
         """The owner's delete is only a request: with the maintenance
         operator's finalizer in place, the CR lingers terminating until the
