@@ -41,7 +41,7 @@ from .node_upgrade_state_provider import NodeUpgradeStateProvider
 from .pod_manager import PodDeletionFilter, PodManager
 from .safe_driver_load_manager import SafeDriverLoadManager
 from .upgrade_inplace import InplaceNodeStateManager
-from .util import EventRecorder
+from .util import EventRecorder, log_event
 from .validation_manager import ValidationManager
 
 logger = logging.getLogger(__name__)
@@ -252,6 +252,27 @@ class ClusterUpgradeStateManager:
             "node states: %s",
             {k or "unknown": len(v) for k, v in state.node_states.items()},
         )
+        # Aggregate-progress event — the reference sketches this but leaves
+        # it commented out (upgrade_state.go:199-202); here it is live,
+        # gated on an active rollout so a steady-state fleet doesn't spam
+        # identical events into a real sink every reconcile.
+        in_progress = common.get_upgrades_in_progress(state)
+        pending = common.get_upgrades_pending(state)
+        failed = common.get_upgrades_failed(state)
+        if in_progress or pending or failed:
+            log_event(
+                self._recorder,
+                util.get_component_name(),
+                "Normal",
+                util.get_event_reason(),
+                "Upgrade progress: done {}/{} inProgress {} pending {} failed {}".format(
+                    common.get_upgrades_done(state),
+                    common.get_total_managed_nodes(state),
+                    in_progress,
+                    pending,
+                    failed,
+                ),
+            )
 
         # 1-2. classify unknown + done nodes
         common.process_done_or_unknown_nodes(state, consts.UPGRADE_STATE_UNKNOWN)

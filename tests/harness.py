@@ -43,6 +43,10 @@ class Fleet:
             make_controller_revision(self.ds, self.revision, revision_hash)
         )
         self._pod_seq = itertools.count()
+        #: node names this DaemonSet schedules onto (add_node only); nodes
+        #: created directly on the cluster (e.g. orphan-pod hosts) are not
+        #: the DS's responsibility, matching real DS node targeting.
+        self.managed_nodes: set = set()
 
     # ------------------------------------------------------------- building
     def add_node(
@@ -77,6 +81,7 @@ class Fleet:
             restart_count=restart_count,
         )
         self.cluster.create(pod)
+        self.managed_nodes.add(name)
         self._bump_desired(+1)
         return node
 
@@ -109,7 +114,7 @@ class Fleet:
         created = 0
         for node in self.cluster.list("Node"):
             name = node["metadata"]["name"]
-            if name in covered:
+            if name in covered or name not in self.managed_nodes:
                 continue
             pod = make_pod(
                 f"tpu-runtime-{next(self._pod_seq)}",

@@ -364,6 +364,86 @@ class TestFullLifecycle:
         assert run_to_completion(manager, fleet, policy)
 
 
+class TestObservability:
+    def test_aggregate_progress_event_emitted(self, cluster, fleet, recorder):
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster, recorder=recorder)
+        policy = UpgradePolicySpec(auto_upgrade=True)
+        reconcile(manager, fleet, policy, cycles=2)
+        progress = [m for m in recorder.messages() if "Upgrade progress" in m]
+        assert progress and "pending" in progress[-1]
+
+    def test_progress_event_silent_at_steady_state(
+        self, cluster, fleet, recorder
+    ):
+        fleet.add_node("n1")  # in sync: nothing to do
+        manager = make_manager(cluster, recorder=recorder)
+        policy = UpgradePolicySpec(auto_upgrade=True)
+        reconcile(manager, fleet, policy, cycles=3)
+        assert not [m for m in recorder.messages() if "Upgrade progress" in m]
+
+    def test_zap_level_mapping(self):
+        import logging
+
+        from k8s_operator_libs_tpu import consts as shared_consts
+
+        assert shared_consts.stdlib_level(shared_consts.LOG_LEVEL_ERROR) == logging.ERROR
+        assert shared_consts.stdlib_level(shared_consts.LOG_LEVEL_DEBUG) == logging.DEBUG
+        assert shared_consts.stdlib_level(7) == logging.DEBUG  # chattier clamps
+        assert shared_consts.stdlib_level(-5) == logging.ERROR  # severe clamps up
+
+
+class TestOrphanedPodLifecycle:
+    def test_orphaned_pod_classifies_done_until_requested(self, cluster, fleet):
+        """Reference semantics (upgrade_state_test.go:1180-1295): an
+        orphaned driver pod does NOT trigger an upgrade by itself —
+        classification forces upgrade only when out-of-sync AND owned.  An
+        explicit upgrade-requested annotation pushes the orphaned node
+        through the flow; the restart phase deletes the orphan and the DS
+        controller's replacement (owned, current revision) completes it."""
+        fleet.add_node("n-owned")
+        cluster.create(make_node("n-orphan"))
+        cluster.create(
+            make_pod(
+                "orphan-pod",
+                NAMESPACE,
+                "n-orphan",
+                labels=dict(DRIVER_LABELS),
+                revision_hash="rev1",
+            )
+        )
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(auto_upgrade=True, max_parallel_upgrades=0)
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n-orphan") == consts.UPGRADE_STATE_DONE
+        assert cluster.exists("Pod", "orphan-pod", NAMESPACE)
+        # force an upgrade cycle on the orphaned node
+        cluster.patch(
+            "Node",
+            "n-orphan",
+            {
+                "metadata": {
+                    "annotations": {
+                        util.get_upgrade_requested_annotation_key(): "true"
+                    }
+                }
+            },
+        )
+        for _ in range(10):
+            reconcile(manager, fleet, policy)
+            if not cluster.exists("Pod", "orphan-pod", NAMESPACE):
+                break
+        # the restart phase deleted the orphan; with no DaemonSet targeting
+        # the node, it drops out of BuildState (reference semantics: nodes
+        # are managed through their driver pods)
+        assert not cluster.exists("Pod", "orphan-pod", NAMESPACE)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        assert {ns.node["metadata"]["name"] for ns in state.all_node_states()} == {
+            "n-owned"
+        }
+
+
 class TestThrottleMatrix:
     """Reference: upgrade_state_test.go:294-613."""
 
