@@ -15,8 +15,10 @@ Reference parity: ``pkg/upgrade/node_upgrade_state_provider.go`` —
 from __future__ import annotations
 
 import logging
+import threading
 import time
-from typing import Optional
+from contextlib import contextmanager
+from typing import Iterator, List, Optional, Tuple
 
 from ..cluster.cache import InformerCache
 from ..cluster.errors import NotFoundError
@@ -28,6 +30,13 @@ logger = logging.getLogger(__name__)
 
 DEFAULT_CACHE_SYNC_TIMEOUT_SECONDS = 10.0
 DEFAULT_CACHE_SYNC_POLL_SECONDS = 1.0
+
+
+def _rv_of(obj: JsonObj) -> int:
+    try:
+        return int((obj.get("metadata") or {}).get("resourceVersion", 0))
+    except (TypeError, ValueError):
+        return 0
 
 
 class CacheSyncTimeoutError(Exception):
@@ -51,6 +60,18 @@ class NodeUpgradeStateProvider:
         self._keyed_mutex = KeyedMutex()
         self._timeout = cache_sync_timeout_seconds
         self._poll = cache_sync_poll_seconds
+        # Deferred-visibility machinery: inside a deferred_visibility()
+        # block (strictly thread-local — both the flag and the pending
+        # list — so background drain/eviction workers and concurrent
+        # reconcilers are unaffected), writes enqueue the resourceVersion
+        # they produced instead of blocking, and the block exit waits for
+        # the cache to catch up to all of them at once — amortizing the
+        # informer lag across a whole reconcile instead of paying it per
+        # write (the reference waits per write,
+        # node_upgrade_state_provider.go:100-117).  Waiting on RVs rather
+        # than label values keeps the wait satisfiable even when a later
+        # writer (e.g. an async drain worker) overwrites the same key.
+        self._local = threading.local()
 
     # ------------------------------------------------------------------ reads
     def get_node(self, name: str) -> JsonObj:
@@ -73,8 +94,8 @@ class NodeUpgradeStateProvider:
                 patch: JsonObj = {"metadata": {"labels": {key: None}}}
             else:
                 patch = {"metadata": {"labels": {key: new_state}}}
-            self._cluster.patch("Node", name, patch)
-            self._wait_visible_label(name, key, new_state)
+            updated = self._cluster.patch("Node", name, patch)
+            self._wait_or_defer(name, _rv_of(updated))
         node.setdefault("metadata", {}).setdefault("labels", {})
         if new_state == consts.UPGRADE_STATE_UNKNOWN:
             node["metadata"]["labels"].pop(key, None)
@@ -101,51 +122,97 @@ class NodeUpgradeStateProvider:
         delete = value == consts.NULL_STRING
         with self._keyed_mutex.lock(name):
             patch_value = None if delete else value
-            self._cluster.patch(
+            updated = self._cluster.patch(
                 "Node", name, {"metadata": {"annotations": {key: patch_value}}}
             )
-            self._wait_visible_annotation(name, key, None if delete else value)
+            self._wait_or_defer(name, _rv_of(updated))
         node.setdefault("metadata", {}).setdefault("annotations", {})
         if delete:
             node["metadata"]["annotations"].pop(key, None)
         else:
             node["metadata"]["annotations"][key] = value
 
+    # ----------------------------------------------------- deferred waits
+    @contextmanager
+    def deferred_visibility(self) -> Iterator[None]:
+        """Batch visibility waits for writes made by *this thread* inside
+        the block; the block exit polls all of them together.  Equivalent
+        consistency: every write is cache-visible before the block (and
+        hence the reconcile) completes, so the next BuildState still never
+        reads stale state — but N writes cost one informer-lag wait, not N.
+
+        If the body raises, the pending waits are discarded and the
+        original exception propagates — a lagging cache must not convert a
+        processor error into a CacheSyncTimeoutError (the next reconcile
+        re-derives everything from live state anyway).
+        """
+        depth = getattr(self._local, "defer_depth", 0)
+        self._local.defer_depth = depth + 1
+        if depth == 0:
+            self._local.pending = []
+        try:
+            yield
+        except BaseException:
+            if depth == 0:
+                self._local.pending = []
+            raise
+        finally:
+            self._local.defer_depth = depth
+        if depth == 0:
+            self.flush_visibility_waits()
+
+    def _defer_active(self) -> bool:
+        return getattr(self._local, "defer_depth", 0) > 0
+
+    def flush_visibility_waits(self) -> None:
+        """Wait until the cache has caught up to every pending write made
+        by this thread."""
+        pending: List[Tuple[str, int]] = getattr(self._local, "pending", [])
+        self._local.pending = []
+        if not pending:
+            return
+        # Only the newest awaited RV per node matters.
+        wanted: dict = {}
+        for name, rv in pending:
+            wanted[name] = max(rv, wanted.get(name, 0))
+        deadline = time.monotonic() + self._timeout
+        while wanted:
+            for name, rv in list(wanted.items()):
+                if self._cache_caught_up(name, rv):
+                    del wanted[name]
+            if not wanted:
+                return
+            if time.monotonic() >= deadline:
+                raise CacheSyncTimeoutError(
+                    "writes to nodes not visible in cache after "
+                    f"{self._timeout}s: {sorted(wanted)}"
+                )
+            time.sleep(self._poll)
+
+    def _wait_or_defer(self, name: str, rv: int) -> None:
+        if self._defer_active():
+            self._local.pending.append((name, rv))
+            return
+        self._wait_visible(name, rv)
+
     # ------------------------------------------------------------- internals
-    def _wait_visible(self, name: str, predicate) -> None:
+    def _cache_caught_up(self, name: str, rv: int) -> bool:
+        """True when the cache serves this node at resourceVersion >= *rv*
+        (a later write advancing past ours also counts as caught up)."""
+        try:
+            cached = self._cache.get("Node", name)
+        except NotFoundError:
+            return False
+        return _rv_of(cached) >= rv
+
+    def _wait_visible(self, name: str, rv: int) -> None:
         deadline = time.monotonic() + self._timeout
         while True:
-            try:
-                cached = self._cache.get("Node", name)
-                if predicate(cached):
-                    return
-            except NotFoundError:
-                pass
+            if self._cache_caught_up(name, rv):
+                return
             if time.monotonic() >= deadline:
                 raise CacheSyncTimeoutError(
                     f"write to node {name} not visible in cache after "
                     f"{self._timeout}s"
                 )
             time.sleep(self._poll)
-
-    def _wait_visible_label(
-        self, name: str, key: str, want: Optional[str]
-    ) -> None:
-        def pred(cached: JsonObj) -> bool:
-            labels = (cached.get("metadata") or {}).get("labels") or {}
-            if want == consts.UPGRADE_STATE_UNKNOWN:
-                return key not in labels
-            return labels.get(key) == want
-
-        self._wait_visible(name, pred)
-
-    def _wait_visible_annotation(
-        self, name: str, key: str, want: Optional[str]
-    ) -> None:
-        def pred(cached: JsonObj) -> bool:
-            anns = (cached.get("metadata") or {}).get("annotations") or {}
-            if want is None:
-                return key not in anns
-            return anns.get(key) == want
-
-        self._wait_visible(name, pred)

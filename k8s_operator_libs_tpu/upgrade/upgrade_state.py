@@ -274,33 +274,43 @@ class ClusterUpgradeStateManager:
                 ),
             )
 
-        # 1-2. classify unknown + done nodes
-        common.process_done_or_unknown_nodes(state, consts.UPGRADE_STATE_UNKNOWN)
-        common.process_done_or_unknown_nodes(state, consts.UPGRADE_STATE_DONE)
-        # 3. start upgrades up to the throttle (mode dispatch)
-        self._process_upgrade_required_nodes_wrapper(state, policy)
-        # 4. cordon
-        common.process_cordon_required_nodes(state)
-        # 5. wait for jobs
-        common.process_wait_for_jobs_required_nodes(
-            state, policy.wait_for_completion
-        )
-        # 6. pod deletion
-        drain_enabled = policy.drain_spec is not None and policy.drain_spec.enable
-        common.process_pod_deletion_required_nodes(
-            state, policy.pod_deletion, drain_enabled
-        )
-        # 7. drain
-        common.process_drain_nodes(state, policy.drain_spec)
-        # 8. node-maintenance (requestor mode only)
-        self._process_node_maintenance_required_nodes_wrapper(state)
-        # 9. pod restart (+ failure detection)
-        common.process_pod_restart_nodes(state)
-        # 10. failed-node self-healing, then validation
-        common.process_upgrade_failed_nodes(state)
-        common.process_validation_required_nodes(state)
-        # 11. uncordon (both modes' processors run — reference :311-325)
-        self._process_uncordon_required_nodes_wrapper(state)
+        # All phases run under one deferred-visibility barrier: node writes
+        # land immediately, and their informer-cache visibility is awaited
+        # once at the end — the next reconcile still never reads stale
+        # state, but N writes cost one cache-lag wait instead of N (the
+        # reference pays the wait per write).
+        with self._provider.deferred_visibility():
+            # 1-2. classify unknown + done nodes
+            common.process_done_or_unknown_nodes(
+                state, consts.UPGRADE_STATE_UNKNOWN
+            )
+            common.process_done_or_unknown_nodes(state, consts.UPGRADE_STATE_DONE)
+            # 3. start upgrades up to the throttle (mode dispatch)
+            self._process_upgrade_required_nodes_wrapper(state, policy)
+            # 4. cordon
+            common.process_cordon_required_nodes(state)
+            # 5. wait for jobs
+            common.process_wait_for_jobs_required_nodes(
+                state, policy.wait_for_completion
+            )
+            # 6. pod deletion
+            drain_enabled = (
+                policy.drain_spec is not None and policy.drain_spec.enable
+            )
+            common.process_pod_deletion_required_nodes(
+                state, policy.pod_deletion, drain_enabled
+            )
+            # 7. drain
+            common.process_drain_nodes(state, policy.drain_spec)
+            # 8. node-maintenance (requestor mode only)
+            self._process_node_maintenance_required_nodes_wrapper(state)
+            # 9. pod restart (+ failure detection)
+            common.process_pod_restart_nodes(state)
+            # 10. failed-node self-healing, then validation
+            common.process_upgrade_failed_nodes(state)
+            common.process_validation_required_nodes(state)
+            # 11. uncordon (both modes' processors run — reference :311-325)
+            self._process_uncordon_required_nodes_wrapper(state)
 
     # ---------------------------------------------------- mode dispatchers
     def _process_upgrade_required_nodes_wrapper(

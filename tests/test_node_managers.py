@@ -121,6 +121,145 @@ class TestNodeUpgradeStateProvider:
         with pytest.raises(CacheSyncTimeoutError):
             provider.change_node_upgrade_state(node, consts.UPGRADE_STATE_DONE)
 
+    def test_deferred_visibility_batches_waits(self, cluster, recorder):
+        cache = InformerCache(cluster, lag_seconds=0.05)
+        provider = NodeUpgradeStateProvider(
+            cluster,
+            cache,
+            recorder,
+            cache_sync_timeout_seconds=3.0,
+            cache_sync_poll_seconds=0.01,
+        )
+        nodes = [cluster.create(make_node(f"n{i}")) for i in range(10)]
+        cache.sync()
+        t0 = time.monotonic()
+        with provider.deferred_visibility():
+            for node in nodes:
+                provider.change_node_upgrade_state(
+                    node, consts.UPGRADE_STATE_DONE
+                )
+            # inside the block: writes are not yet awaited
+        elapsed = time.monotonic() - t0
+        # 10 writes amortize ONE cache-lag wait, not 10 — comfortably under
+        # the serial cost (10 x 50ms lag = 0.5s) even on a loaded machine
+        assert elapsed < 0.45
+        for i in range(10):
+            assert (
+                get_label(
+                    cache.get("Node", f"n{i}"), util.get_upgrade_state_label_key()
+                )
+                == consts.UPGRADE_STATE_DONE
+            )
+
+    def test_deferred_visibility_thread_local(self, cluster, recorder):
+        # A background thread writing while the main thread is inside a
+        # deferred block must still wait synchronously (its own writes are
+        # not deferred).
+        import threading
+
+        cache = InformerCache(cluster, lag_seconds=0.02)
+        provider = NodeUpgradeStateProvider(
+            cluster,
+            cache,
+            recorder,
+            cache_sync_timeout_seconds=3.0,
+            cache_sync_poll_seconds=0.01,
+        )
+        node_bg = cluster.create(make_node("bg"))
+        node_fg = cluster.create(make_node("fg"))
+        cache.sync()
+        visible_at_return = {}
+
+        def worker():
+            provider.change_node_upgrade_state(
+                node_bg, consts.UPGRADE_STATE_FAILED
+            )
+            visible_at_return["bg"] = get_label(
+                cache.get("Node", "bg"), util.get_upgrade_state_label_key()
+            )
+
+        with provider.deferred_visibility():
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+            provider.change_node_upgrade_state(node_fg, consts.UPGRADE_STATE_DONE)
+        assert visible_at_return["bg"] == consts.UPGRADE_STATE_FAILED
+
+    def test_deferred_wait_survives_concurrent_overwrite(
+        self, cluster, recorder
+    ):
+        """Regression: a background worker overwriting the same label while
+        a deferred wait is pending must not make the flush unsatisfiable —
+        visibility is RV-catch-up, not value equality."""
+        import threading
+
+        cache = InformerCache(cluster, lag_seconds=0.05)
+        provider = NodeUpgradeStateProvider(
+            cluster,
+            cache,
+            recorder,
+            cache_sync_timeout_seconds=2.0,
+            cache_sync_poll_seconds=0.01,
+        )
+        node = cluster.create(make_node("n1"))
+        cache.sync()
+        with provider.deferred_visibility():
+            provider.change_node_upgrade_state(
+                node, consts.UPGRADE_STATE_DRAIN_REQUIRED
+            )
+            # a drain worker finishes and overwrites the state meanwhile
+            t = threading.Thread(
+                target=provider.change_node_upgrade_state,
+                args=(dict(node), consts.UPGRADE_STATE_POD_RESTART_REQUIRED),
+            )
+            t.start()
+            t.join()
+        # flush returned (no CacheSyncTimeoutError); last writer won
+        assert (
+            get_label(
+                cluster.get("Node", "n1"), util.get_upgrade_state_label_key()
+            )
+            == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+        )
+
+    def test_deferred_block_exception_skips_flush(self, cluster, recorder):
+        cache = InformerCache(cluster, lag_seconds=9999)  # would never sync
+        provider = NodeUpgradeStateProvider(
+            cluster,
+            cache,
+            recorder,
+            cache_sync_timeout_seconds=0.5,
+            cache_sync_poll_seconds=0.02,
+        )
+        node = cluster.create(make_node("n1"))
+        cache.sync()
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="processor blew up"):
+            with provider.deferred_visibility():
+                provider.change_node_upgrade_state(
+                    node, consts.UPGRADE_STATE_DONE
+                )
+                raise RuntimeError("processor blew up")
+        # original error propagated immediately; no timeout wait occurred
+        assert time.monotonic() - t0 < 0.4
+
+    def test_deferred_visibility_timeout_lists_nodes(self, cluster, recorder):
+        cache = InformerCache(cluster, lag_seconds=9999)
+        provider = NodeUpgradeStateProvider(
+            cluster,
+            cache,
+            recorder,
+            cache_sync_timeout_seconds=0.1,
+            cache_sync_poll_seconds=0.02,
+        )
+        node = cluster.create(make_node("n1"))
+        cache.sync()
+        with pytest.raises(CacheSyncTimeoutError, match="n1"):
+            with provider.deferred_visibility():
+                provider.change_node_upgrade_state(
+                    node, consts.UPGRADE_STATE_DONE
+                )
+
     def test_emits_event(self, cluster, provider, recorder):
         node = cluster.create(make_node("n1"))
         provider.change_node_upgrade_state(node, consts.UPGRADE_STATE_DONE)
