@@ -8,6 +8,8 @@ policy, pod-restart/safe-load/failure, validation, uncordon, and the
 upgrade-requested annotation flow — plus the TPU slice-aware throttle.
 """
 
+import time
+
 import pytest
 
 from k8s_operator_libs_tpu.api import (
@@ -576,6 +578,126 @@ class TestThrottleMatrix:
         policy = UpgradePolicySpec(auto_upgrade=True, max_parallel_upgrades=8)
         reconcile(manager, fleet, policy, cycles=2)
         assert fleet.node_state("skipme") == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+
+
+class TestPolicyVariants:
+    """Reference: the drain-policy matrix (upgrade_state_test.go:696-788)
+    at the state-machine level, plus mid-rollout perturbations.  The
+    pod-deletion matrix (:615-694) is covered in TestFullLifecycle and
+    tests/test_node_managers.py::TestPodEviction."""
+
+    def test_drain_pod_selector_spares_unselected_pods(self, cluster, fleet):
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        rs = {"kind": "ReplicaSet", "metadata": {"name": "rs", "namespace": "ml"}}
+        cluster.create(
+            make_pod("evictme", "ml", "n1", labels={"tier": "batch"}, owner=rs)
+        )
+        cluster.create(
+            make_pod("keepme", "ml", "n1", labels={"tier": "critical"}, owner=rs)
+        )
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            drain_spec=DrainSpec(
+                enable=True, force=True, pod_selector="tier=batch",
+                timeout_second=10,
+            ),
+        )
+        assert run_to_completion(manager, fleet, policy)
+        remaining = [p["metadata"]["name"] for p in cluster.list("Pod", namespace="ml")]
+        assert remaining == ["keepme"]
+
+    def test_revision_bump_mid_rollout_converges_to_newest(self, cluster, fleet):
+        for i in range(3):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString("100%"),
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        # progress partway, then a newer revision lands
+        for _ in range(8):
+            reconcile(manager, fleet, policy)
+        fleet.publish_new_revision("rev3")
+        assert run_to_completion(manager, fleet, policy, max_cycles=60)
+        hashes = {
+            get_label(p, "controller-revision-hash")
+            for p in cluster.list("Pod", namespace=NAMESPACE)
+        }
+        assert hashes == {"rev3"}
+
+    def test_node_turning_not_ready_pauses_new_admissions(self, cluster, fleet):
+        for i in range(4):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString(1),
+        )
+        reconcile(manager, fleet, policy)  # classification
+        # a node goes NotReady before any admission
+        sick = cluster.get("Node", "n3")
+        set_condition(sick, "Ready", "False")
+        cluster.update(sick)
+        reconcile(manager, fleet, policy)
+        started = [
+            n
+            for n, s in fleet.states().items()
+            if s not in ("", consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        ]
+        assert started == []  # the sick node consumed the whole budget
+        # node recovers: admissions resume
+        sick = cluster.get("Node", "n3")
+        set_condition(sick, "Ready", "True")
+        cluster.update(sick)
+        reconcile(manager, fleet, policy)
+        started = [
+            n
+            for n, s in fleet.states().items()
+            if s not in ("", consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        ]
+        assert len(started) == 1
+
+    def test_wait_for_jobs_timeout_at_state_level(self, cluster, fleet):
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        rs = {"kind": "ReplicaSet", "metadata": {"name": "rs", "namespace": "ml"}}
+        cluster.create(
+            make_pod("stuck-job", "ml", "n1", labels={"kind": "job"}, owner=rs,
+                     phase="Running")
+        )
+        manager = make_manager(cluster)
+        # large timeout: expiry is driven by explicit backdating below, so
+        # wall-clock hiccups on a loaded machine can't trip it early
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            wait_for_completion=WaitForCompletionSpec(
+                pod_selector="kind=job", timeout_second=3600
+            ),
+        )
+        for _ in range(4):
+            reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED
+        # back-date the tracked start time past the timeout to force expiry
+        key = util.get_wait_for_pod_completion_start_time_annotation_key()
+        cluster.patch(
+            "Node",
+            "n1",
+            {"metadata": {"annotations": {key: str(int(time.time()) - 7200)}}},
+        )
+        reconcile(manager, fleet, policy)
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") in (
+            consts.UPGRADE_STATE_POD_DELETION_REQUIRED,
+            consts.UPGRADE_STATE_DRAIN_REQUIRED,
+            consts.UPGRADE_STATE_POD_RESTART_REQUIRED,
+        )
 
 
 class TestSliceAwareThrottle:
