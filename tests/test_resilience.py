@@ -1,0 +1,274 @@
+"""Chaos / property suite: crash-resume idempotency and throttle
+invariants under randomized fleets, crash points, and interleavings.
+
+The reference's core resilience claim is architectural, not tested: all
+state lives in node labels/annotations so an operator restart resumes
+mid-upgrade for free (upgrade_state.go:49-50), and idempotent processing
+makes double-running reconcilers safe.  The reference suite never probes
+either (SURVEY.md §5: no race detection, no fault injection).  This suite
+does, the property-based way:
+
+* **crash-resume** — an injected fault truncates the reconcile's write
+  sequence after a random number of mutations (the operator dying
+  mid-ApplyState); a *fresh* manager over the same cluster must pick up
+  from the half-written labels and still converge;
+* **throttle invariants** — at every settled point of every randomized
+  rollout, the fleet never exceeds the resolved maxUnavailable budget and
+  never runs more concurrent upgrades than maxParallelUpgrades, in node
+  units or slice-domain units per policy;
+* **split-brain** — two managers (an HA operator pair that both think
+  they lead) interleave reconciles over one cluster; idempotency must
+  keep the invariants and convergence intact.
+
+Seeds are fixed per spec for reproducibility.
+"""
+
+import random
+import threading
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DrainSpec, IntOrString, UpgradePolicySpec
+from k8s_operator_libs_tpu.cluster import InformerCache, InMemoryCluster
+from k8s_operator_libs_tpu.cluster.objects import (
+    node_is_ready,
+    node_is_unschedulable,
+)
+from k8s_operator_libs_tpu.tpu import topology
+from k8s_operator_libs_tpu.upgrade import consts, util
+from k8s_operator_libs_tpu.upgrade.upgrade_state import ClusterUpgradeStateManager
+
+from harness import DRIVER_LABELS, NAMESPACE, Fleet
+
+SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
+
+IDLE_STATES = ("", consts.UPGRADE_STATE_DONE, consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+
+
+class SimulatedCrash(RuntimeError):
+    """The injected operator death."""
+
+
+class CrashingCluster:
+    """Wraps an :class:`InMemoryCluster`; after an armed budget of mutating
+    calls *from the arming thread* it raises :class:`SimulatedCrash`,
+    truncating the reconcile's write sequence exactly where an operator
+    crash would.  Background drain/eviction threads are exempt — they die
+    with the old manager via ``wait_idle`` in the driver loop instead."""
+
+    _MUTATORS = frozenset({"create", "update", "patch", "delete"})
+
+    def __init__(self, inner: InMemoryCluster):
+        self._inner = inner
+        self._budget = None
+        self._thread = None
+
+    def arm(self, budget: int) -> None:
+        self._budget = budget
+        self._thread = threading.get_ident()
+
+    def disarm(self) -> None:
+        self._budget = None
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in self._MUTATORS:
+
+            def wrapped(*args, **kwargs):
+                if (
+                    self._budget is not None
+                    and threading.get_ident() == self._thread
+                ):
+                    if self._budget <= 0:
+                        raise SimulatedCrash(f"crashed before {name}")
+                    self._budget -= 1
+                return attr(*args, **kwargs)
+
+            return wrapped
+        return attr
+
+
+def build_random_fleet(rng: random.Random, cluster) -> Fleet:
+    """2-3 slices x 2-3 hosts plus 0-2 singletons, all out of date."""
+    fleet = Fleet(cluster)
+    for s in range(rng.randint(2, 3)):
+        for h in range(rng.randint(2, 3)):
+            fleet.add_node(
+                f"s{s}-h{h}", pod_hash="rev1", labels={SLICE_KEY: f"slice-{s}"}
+            )
+    for i in range(rng.randint(0, 2)):
+        fleet.add_node(f"solo{i}", pod_hash="rev1")
+    fleet.publish_new_revision("rev2")
+    return fleet
+
+
+def random_policy(rng: random.Random) -> UpgradePolicySpec:
+    return UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=rng.choice([0, 1, 2]),
+        max_unavailable=IntOrString(rng.choice([1, 2, "25%", "50%"])),
+        slice_aware=rng.choice([True, False]),
+        drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+    )
+
+
+def make_manager(cluster, lag_seconds: float = 0.0) -> ClusterUpgradeStateManager:
+    return ClusterUpgradeStateManager(
+        cluster,
+        cache=InformerCache(cluster, lag_seconds=lag_seconds),
+        cache_sync_timeout_seconds=2.0,
+        cache_sync_poll_seconds=0.005,
+    )
+
+
+def check_invariants(cluster, policy: UpgradePolicySpec) -> None:
+    """Never more unavailable capacity than the budget, never more
+    concurrent upgrades than maxParallelUpgrades — in the policy's units."""
+    nodes = cluster.list("Node")
+    state_key = util.get_upgrade_state_label_key()
+
+    def node_state(n):
+        return (n["metadata"].get("labels") or {}).get(state_key, "")
+
+    active = [n for n in nodes if node_state(n) not in IDLE_STATES]
+    unavailable = [
+        n for n in nodes if node_is_unschedulable(n) or not node_is_ready(n)
+    ]
+    if policy.slice_aware:
+        total = topology.count_domains(nodes)
+        n_active = len({topology.domain_of(n) for n in active})
+        n_unavailable = len({topology.domain_of(n) for n in unavailable})
+    else:
+        total = len(nodes)
+        n_active = len(active)
+        n_unavailable = len(unavailable)
+
+    budget = policy.max_unavailable.scaled_value(total, round_up=True)
+    assert n_unavailable <= budget, (
+        f"{n_unavailable} unavailable exceeds maxUnavailable={budget} "
+        f"(slice_aware={policy.slice_aware})"
+    )
+    if policy.max_parallel_upgrades > 0:
+        assert n_active <= policy.max_parallel_upgrades, (
+            f"{n_active} concurrent upgrades exceed "
+            f"maxParallelUpgrades={policy.max_parallel_upgrades}"
+        )
+
+
+def drive(
+    manager,
+    fleet,
+    policy,
+    cluster,
+    *,
+    rng=None,
+    crashing=None,
+    lag_seconds: float = 0.0,
+    max_cycles: int = 80,
+    managers=None,
+) -> bool:
+    """Reconcile until the whole fleet is upgrade-done at the new revision.
+
+    Each cycle optionally arms a random crash budget; a crash swaps in a
+    fresh manager (operator restart).  When *managers* is given, each
+    cycle's reconcile is run by a randomly chosen manager (split-brain).
+    """
+    for _ in range(max_cycles):
+        active = rng.choice(managers) if managers else manager
+        try:
+            if crashing is not None and rng.random() < 0.5:
+                crashing.arm(rng.randint(0, 6))
+            state = active.build_state(NAMESPACE, DRIVER_LABELS)
+            active.apply_state(state, policy)
+        except SimulatedCrash:
+            pass
+        finally:
+            if crashing is not None:
+                crashing.disarm()
+        active.drain_manager.wait_idle(10.0)
+        active.pod_manager.wait_idle(10.0)
+        if crashing is not None:
+            # the crashed operator is replaced by a fresh process: new
+            # manager, new informer cache, no in-memory carry-over
+            manager = make_manager(cluster, lag_seconds=lag_seconds)
+        fleet.reconcile_daemonset()
+        check_invariants(cluster, policy)
+        states = set(fleet.states().values())
+        if states == {consts.UPGRADE_STATE_DONE}:
+            return True
+    return False
+
+
+def assert_all_pods_at(cluster, revision_hash: str) -> None:
+    for pod in cluster.list("Pod", namespace=NAMESPACE):
+        assert (
+            pod["metadata"]["labels"]["controller-revision-hash"]
+            == revision_hash
+        )
+
+
+class TestCrashResume:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_crash_points_still_converge(self, seed):
+        rng = random.Random(seed)
+        inner = InMemoryCluster()
+        cluster = CrashingCluster(inner)
+        fleet = build_random_fleet(rng, cluster)
+        policy = random_policy(rng)
+        manager = make_manager(cluster)
+        assert drive(
+            manager, fleet, policy, cluster, rng=rng, crashing=cluster
+        ), f"seed {seed} did not converge: {fleet.states()}"
+        assert_all_pods_at(inner, "rev2")
+
+    @pytest.mark.parametrize("seed", range(2))
+    def test_crash_resume_with_lagged_informer_cache(self, seed):
+        """Restarted operators resume from a *stale* cache: the
+        cache-visibility wait must keep half-written state from being
+        processed twice (node_upgrade_state_provider.go:100-117)."""
+        rng = random.Random(1000 + seed)
+        inner = InMemoryCluster()
+        cluster = CrashingCluster(inner)
+        fleet = build_random_fleet(rng, cluster)
+        policy = random_policy(rng)
+        manager = make_manager(cluster, lag_seconds=0.02)
+        assert drive(
+            manager,
+            fleet,
+            policy,
+            cluster,
+            rng=rng,
+            crashing=cluster,
+            lag_seconds=0.02,
+        ), f"seed {seed} did not converge: {fleet.states()}"
+        assert_all_pods_at(inner, "rev2")
+
+
+class TestThrottleInvariantsProperty:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_fleets_never_exceed_budgets(self, seed):
+        rng = random.Random(2000 + seed)
+        cluster = InMemoryCluster()
+        fleet = build_random_fleet(rng, cluster)
+        policy = random_policy(rng)
+        manager = make_manager(cluster)
+        assert drive(
+            manager, fleet, policy, cluster, rng=rng
+        ), f"seed {seed} did not converge: {fleet.states()}"
+        assert_all_pods_at(cluster, "rev2")
+
+
+class TestSplitBrain:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_two_managers_interleaved(self, seed):
+        """An HA pair where both replicas reconcile: label-idempotency
+        must make the duplicate processing harmless."""
+        rng = random.Random(3000 + seed)
+        cluster = InMemoryCluster()
+        fleet = build_random_fleet(rng, cluster)
+        policy = random_policy(rng)
+        managers = [make_manager(cluster), make_manager(cluster)]
+        assert drive(
+            None, fleet, policy, cluster, rng=rng, managers=managers
+        ), f"seed {seed} did not converge: {fleet.states()}"
+        assert_all_pods_at(cluster, "rev2")
