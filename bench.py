@@ -12,7 +12,8 @@ informer lag, twice:
 * **baseline config** = the reference's defaults (maxParallelUpgrades=1,
   maxUnavailable=25%, node-at-a-time semantics);
 * **tuned config**    = this framework's TPU mode (slice-aware domains,
-  maxParallelUpgrades=0 i.e. bounded only by slice budget).
+  maxParallelUpgrades=0 i.e. bounded only by slice budget, pipelined
+  cascade reconcile).
 
 Prints ONE JSON line: ``metric`` is the tuned nodes/min; ``vs_baseline``
 is the speedup over the reference-default configuration on the identical
@@ -56,7 +57,9 @@ def build_fleet(cluster: InMemoryCluster) -> Fleet:
     return fleet
 
 
-def run_rollout(policy: UpgradePolicySpec, max_cycles: int = 500) -> float:
+def run_rollout(
+    policy: UpgradePolicySpec, max_cycles: int = 500, cascade: bool = False
+) -> float:
     """Returns wall-clock seconds for the whole fleet to reach upgrade-done."""
     cluster = InMemoryCluster()
     fleet = build_fleet(cluster)
@@ -64,6 +67,7 @@ def run_rollout(policy: UpgradePolicySpec, max_cycles: int = 500) -> float:
     manager = ClusterUpgradeStateManager(
         cluster,
         cache=cache,
+        cascade=cascade,
         cache_sync_timeout_seconds=5.0,
         cache_sync_poll_seconds=0.005,
     )
@@ -98,7 +102,7 @@ def main() -> None:
     )
 
     baseline_s = run_rollout(baseline_policy)
-    tuned_s = run_rollout(tuned_policy)
+    tuned_s = run_rollout(tuned_policy, cascade=True)
 
     baseline_rate = N_NODES / (baseline_s / 60.0)
     tuned_rate = N_NODES / (tuned_s / 60.0)

@@ -101,6 +101,9 @@ class NodeUpgradeStateProvider:
             node["metadata"]["labels"].pop(key, None)
         else:
             node["metadata"]["labels"][key] = new_state
+        listener = getattr(self._local, "listener", None)
+        if listener is not None:
+            listener(node, new_state)
         log_event(
             self._recorder,
             name,
@@ -131,6 +134,24 @@ class NodeUpgradeStateProvider:
             node["metadata"]["annotations"].pop(key, None)
         else:
             node["metadata"]["annotations"][key] = value
+
+    # ------------------------------------------------- transition listener
+    @contextmanager
+    def transition_listener(self, callback) -> Iterator[None]:
+        """Invoke ``callback(node, new_state)`` after every successful
+        state-label write made by *this thread* inside the block.
+
+        Strictly thread-local, like :meth:`deferred_visibility`: background
+        drain/eviction workers writing through the same provider never
+        fire a listener registered by the reconcile thread.  Used by the
+        pipelined (cascading) ApplyState to migrate nodes between state
+        buckets mid-pass."""
+        prev = getattr(self._local, "listener", None)
+        self._local.listener = callback
+        try:
+            yield
+        finally:
+            self._local.listener = prev
 
     # ----------------------------------------------------- deferred waits
     @contextmanager

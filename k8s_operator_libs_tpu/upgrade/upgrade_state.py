@@ -62,6 +62,7 @@ class ClusterUpgradeStateManager:
         requestor: Optional[object] = None,
         use_maintenance_operator: bool = False,
         pre_drain_gate: Optional[PreDrainGate] = None,
+        cascade: bool = False,
         cache_sync_timeout_seconds: float = 10.0,
         cache_sync_poll_seconds: float = 1.0,
         # test injection points (the reference wires mocks the same way,
@@ -96,6 +97,7 @@ class ClusterUpgradeStateManager:
         self._safe_load_manager = safe_driver_load_manager or SafeDriverLoadManager(
             self._provider
         )
+        self._cascade = cascade
         self._pod_deletion_enabled = False
         self._validation_enabled = False
         self._common: Optional[CommonUpgradeManager] = None
@@ -279,38 +281,85 @@ class ClusterUpgradeStateManager:
         # once at the end — the next reconcile still never reads stale
         # state, but N writes cost one cache-lag wait instead of N (the
         # reference pays the wait per write).
-        with self._provider.deferred_visibility():
+        drain_enabled = policy.drain_spec is not None and policy.drain_spec.enable
+        phases = [
             # 1-2. classify unknown + done nodes
-            common.process_done_or_unknown_nodes(
+            lambda: common.process_done_or_unknown_nodes(
                 state, consts.UPGRADE_STATE_UNKNOWN
-            )
-            common.process_done_or_unknown_nodes(state, consts.UPGRADE_STATE_DONE)
+            ),
+            lambda: common.process_done_or_unknown_nodes(
+                state, consts.UPGRADE_STATE_DONE
+            ),
             # 3. start upgrades up to the throttle (mode dispatch)
-            self._process_upgrade_required_nodes_wrapper(state, policy)
+            lambda: self._process_upgrade_required_nodes_wrapper(state, policy),
             # 4. cordon
-            common.process_cordon_required_nodes(state)
+            lambda: common.process_cordon_required_nodes(state),
             # 5. wait for jobs
-            common.process_wait_for_jobs_required_nodes(
+            lambda: common.process_wait_for_jobs_required_nodes(
                 state, policy.wait_for_completion
-            )
+            ),
             # 6. pod deletion
-            drain_enabled = (
-                policy.drain_spec is not None and policy.drain_spec.enable
-            )
-            common.process_pod_deletion_required_nodes(
+            lambda: common.process_pod_deletion_required_nodes(
                 state, policy.pod_deletion, drain_enabled
-            )
+            ),
             # 7. drain
-            common.process_drain_nodes(state, policy.drain_spec)
+            lambda: common.process_drain_nodes(state, policy.drain_spec),
             # 8. node-maintenance (requestor mode only)
-            self._process_node_maintenance_required_nodes_wrapper(state)
+            lambda: self._process_node_maintenance_required_nodes_wrapper(state),
             # 9. pod restart (+ failure detection)
-            common.process_pod_restart_nodes(state)
+            lambda: common.process_pod_restart_nodes(state),
             # 10. failed-node self-healing, then validation
-            common.process_upgrade_failed_nodes(state)
-            common.process_validation_required_nodes(state)
+            lambda: common.process_upgrade_failed_nodes(state),
+            lambda: common.process_validation_required_nodes(state),
             # 11. uncordon (both modes' processors run — reference :311-325)
-            self._process_uncordon_required_nodes_wrapper(state)
+            lambda: self._process_uncordon_required_nodes_wrapper(state),
+        ]
+        with self._provider.deferred_visibility():
+            if not self._cascade:
+                for phase in phases:
+                    phase()
+            else:
+                # Pipelined reconcile: a state write migrates the node into
+                # its new bucket *between* phases, so one pass carries a
+                # node through every synchronous transition of the
+                # lifecycle (admission → cordon → jobs → drain-scheduled in
+                # a single reconcile instead of four).  Transitions written
+                # by async drain/eviction workers are excluded (the
+                # listener is thread-local) — those surface at the next
+                # BuildState exactly as in the reference.  Phase order is
+                # unchanged, admission throttling still happens once per
+                # pass against the freshest counts, and each phase sees a
+                # settled bucket (migration never mutates a list mid-
+                # iteration).
+                moves: list = []
+                with self._provider.transition_listener(
+                    lambda node, new_state: moves.append((node, new_state))
+                ):
+                    for phase in phases:
+                        phase()
+                        self._migrate_buckets(state, moves)
+
+    @staticmethod
+    def _migrate_buckets(state: ClusterUpgradeState, moves: list) -> None:
+        """Move nodes whose state label just changed into their new
+        snapshot bucket (cascade mode only)."""
+        while moves:
+            node, new_state = moves.pop(0)
+            name = (node.get("metadata") or {}).get("name")
+            for bucket, node_states in state.node_states.items():
+                if bucket == new_state:
+                    continue
+                for i, ns in enumerate(node_states):
+                    if (
+                        ns.node is not None
+                        and ns.node["metadata"].get("name") == name
+                    ):
+                        node_states.pop(i)
+                        state.node_states.setdefault(new_state, []).append(ns)
+                        break
+                else:
+                    continue
+                break
 
     # ---------------------------------------------------- mode dispatchers
     def _process_upgrade_required_nodes_wrapper(

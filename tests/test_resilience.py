@@ -112,10 +112,13 @@ def random_policy(rng: random.Random) -> UpgradePolicySpec:
     )
 
 
-def make_manager(cluster, lag_seconds: float = 0.0) -> ClusterUpgradeStateManager:
+def make_manager(
+    cluster, lag_seconds: float = 0.0, cascade: bool = False
+) -> ClusterUpgradeStateManager:
     return ClusterUpgradeStateManager(
         cluster,
         cache=InformerCache(cluster, lag_seconds=lag_seconds),
+        cascade=cascade,
         cache_sync_timeout_seconds=2.0,
         cache_sync_poll_seconds=0.005,
     )
@@ -189,8 +192,13 @@ def drive(
         active.pod_manager.wait_idle(10.0)
         if crashing is not None:
             # the crashed operator is replaced by a fresh process: new
-            # manager, new informer cache, no in-memory carry-over
-            manager = make_manager(cluster, lag_seconds=lag_seconds)
+            # manager, new informer cache, no in-memory carry-over; the
+            # replacement may or may not run the pipelined cascade
+            manager = make_manager(
+                cluster,
+                lag_seconds=lag_seconds,
+                cascade=rng.choice([True, False]),
+            )
         fleet.reconcile_daemonset()
         check_invariants(cluster, policy)
         states = set(fleet.states().values())
@@ -251,7 +259,7 @@ class TestThrottleInvariantsProperty:
         cluster = InMemoryCluster()
         fleet = build_random_fleet(rng, cluster)
         policy = random_policy(rng)
-        manager = make_manager(cluster)
+        manager = make_manager(cluster, cascade=rng.choice([True, False]))
         assert drive(
             manager, fleet, policy, cluster, rng=rng
         ), f"seed {seed} did not converge: {fleet.states()}"
@@ -267,7 +275,11 @@ class TestSplitBrain:
         cluster = InMemoryCluster()
         fleet = build_random_fleet(rng, cluster)
         policy = random_policy(rng)
-        managers = [make_manager(cluster), make_manager(cluster)]
+        # one replica pipelines, the other doesn't — the worst mismatch
+        managers = [
+            make_manager(cluster, cascade=True),
+            make_manager(cluster, cascade=False),
+        ]
         assert drive(
             None, fleet, policy, cluster, rng=rng, managers=managers
         ), f"seed {seed} did not converge: {fleet.states()}"

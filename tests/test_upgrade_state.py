@@ -792,3 +792,97 @@ class TestSliceAwareThrottle:
         }
         # exactly one domain started: either both s0 hosts or just lonely
         assert started in ({"s0-h0", "s0-h1"}, {"lonely"})
+
+
+class TestCascadeReconcile:
+    """Pipelined ApplyState: one pass carries a node through every
+    synchronous transition (bucket migration between phases), cutting the
+    reconcile count per wave roughly in half.  Off by default — the
+    reference advances one state per reconcile (its requeue cycle is the
+    event loop, SURVEY §3.2) — and opt-in via the ``cascade`` flag."""
+
+    DRAIN = DrainSpec(enable=True, force=True, timeout_second=10)
+
+    def test_one_pass_reaches_drain_completion(self, cluster, fleet):
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster, cascade=True)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=1, drain_spec=self.DRAIN
+        )
+        # cycle 1: admission → cordon → wait-for-jobs → drain scheduled,
+        # async drain lands pod-restart-required before the settle returns
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+        # non-cascade advances exactly one transition in the same cycle
+        cluster2 = InMemoryCluster()
+        fleet2 = Fleet(cluster2)
+        fleet2.add_node("n1", pod_hash="rev1")
+        fleet2.publish_new_revision("rev2")
+        plain = make_manager(cluster2)
+        reconcile(plain, fleet2, policy)
+        assert fleet2.node_state("n1") == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+
+    def test_full_upgrade_in_three_cycles(self, cluster, fleet):
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster, cascade=True)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=1, drain_spec=self.DRAIN
+        )
+        assert run_to_completion(manager, fleet, policy, max_cycles=3)
+        node = cluster.get("Node", "n1")
+        assert node["spec"]["unschedulable"] is False
+        pods = cluster.list("Pod", namespace=NAMESPACE)
+        assert [get_label(p, "controller-revision-hash") for p in pods] == ["rev2"]
+
+    def test_cascade_respects_slice_throttle(self, cluster, fleet):
+        slice_key = consts.SLICE_ID_LABEL_KEYS[0]
+        for s in range(2):
+            for h in range(4):
+                fleet.add_node(
+                    f"s{s}-h{h}", pod_hash="rev1", labels={slice_key: f"sl-{s}"}
+                )
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster, cascade=True)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString("50%"),
+            slice_aware=True,
+            drain_spec=self.DRAIN,
+        )
+        reconcile(manager, fleet, policy)
+        # exactly one whole slice in flight despite the deep cascade
+        active_slices = {
+            n.split("-")[0]
+            for n, s in fleet.states().items()
+            if s not in ("", consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        }
+        assert len(active_slices) == 1
+        assert run_to_completion(manager, fleet, policy, max_cycles=10)
+
+    def test_cascade_with_optional_states_and_requestor_untouched(
+        self, cluster, fleet
+    ):
+        """Cascade + wait-for-jobs + validation still settle correctly."""
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster, cascade=True).with_validation_enabled(
+            "app=validator"
+        )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=1, drain_spec=self.DRAIN
+        )
+        # cascade parks in validation-required (no validator pod yet) in 3
+        # cycles: pass 1 ends drain-scheduled → async pod-restart-required;
+        # pass 2 schedules the driver-pod restart (recreated between
+        # cycles); pass 3 sees the pod in sync and cascades into validation
+        reconcile(manager, fleet, policy, cycles=3)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_VALIDATION_REQUIRED
+        vpod = make_pod("validator", NAMESPACE, "n1", labels={"app": "validator"})
+        vpod["status"]["containerStatuses"] = [{"name": "v", "ready": True}]
+        cluster.create(vpod)
+        # one more pass: validation → uncordon → done cascades through
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_DONE
