@@ -120,7 +120,12 @@ def convert_policy_to_maintenance_spec(
 class RequestorNodeStateManager:
     """The maintenance-operator handoff strategy (ProcessNodeStateManager)."""
 
-    def __init__(self, common: CommonUpgradeManager, opts: RequestorOptions) -> None:
+    def __init__(
+        self,
+        common: CommonUpgradeManager,
+        opts: RequestorOptions,
+        post_maintenance_hook=None,
+    ) -> None:
         if not opts.use_maintenance_operator:
             raise NodeMaintenanceUpgradeDisabledError(
                 "node maintenance upgrade mode is disabled"
@@ -129,6 +134,19 @@ class RequestorNodeStateManager:
         self._cluster: InMemoryCluster = common._cluster
         self.opts = opts
         self._default_spec: JsonObj = {}
+        #: Optional ``hook(node) -> bool`` run in the post-maintenance
+        #: state.  The reference *declares* post-maintenance-required
+        #: (consts.go:70) but never enters it — the requestor jumps
+        #: straight to pod-restart-required with a tracked intent to route through
+        #: it (upgrade_state.go:249-250, upgrade_requestor.go:437-448).
+        #: Here that intent is finished: with a hook installed, maintenance
+        #: completion transitions to post-maintenance-required, and the
+        #: hook gates the driver-pod restart — the TPU use case being
+        #: slice re-admission checks (ICI links healthy, workload
+        #: checkpoint gate released) before the runtime restarts.  Returns
+        #: True to advance; False — or an exception — parks the node to
+        #: retry next reconcile (failing it pre-restart would wedge).
+        self.post_maintenance_hook = post_maintenance_hook
 
     # ------------------------------------------------------------- naming
     def get_node_maintenance_name(self, node_name: str) -> str:
@@ -350,6 +368,54 @@ class RequestorNodeStateManager:
                 for c in conditions
             )
             if ready:
+                next_state = (
+                    consts.UPGRADE_STATE_POST_MAINTENANCE_REQUIRED
+                    if self.post_maintenance_hook is not None
+                    else consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+                )
+                common.provider.change_node_upgrade_state(node, next_state)
+
+    def process_post_maintenance_required_nodes(
+        self, state: ClusterUpgradeState
+    ) -> None:
+        """Gate the driver-pod restart on the post-maintenance hook.
+
+        Completes the reference's declared-but-unreached state (consts.go:70;
+        intent noted at upgrade_state.go:249-250).  Hook semantics: True advances to
+        pod-restart-required; False — or an exception — leaves the node
+        parked for the next reconcile.  An exception must NOT fail the node:
+        at this point the driver pod is still at the old revision, so the
+        upgrade-failed self-heal (which waits for the pod to come back in
+        sync) could never fire and the node would wedge; transient probe
+        errors retry instead, surfaced via log + event.  Without a hook this
+        state is passed through immediately, so resumed fleets whose labels
+        already carry it never wedge."""
+        common = self._common
+        for node_state in state.nodes_in(
+            consts.UPGRADE_STATE_POST_MAINTENANCE_REQUIRED
+        ):
+            node = node_state.node
+            if self.post_maintenance_hook is None:
+                common.provider.change_node_upgrade_state(
+                    node, consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+                )
+                continue
+            try:
+                done = bool(self.post_maintenance_hook(node))
+            except Exception as exc:
+                logger.exception(
+                    "post-maintenance hook failed for node %s (will retry)",
+                    name_of(node),
+                )
+                util.log_event(
+                    common.recorder,
+                    name_of(node),
+                    "Warning",
+                    util.get_event_reason(),
+                    f"Post-maintenance hook error (will retry): {exc}",
+                )
+                continue
+            if done:
                 common.provider.change_node_upgrade_state(
                     node, consts.UPGRADE_STATE_POD_RESTART_REQUIRED
                 )

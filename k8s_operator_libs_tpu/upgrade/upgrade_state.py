@@ -304,8 +304,11 @@ class ClusterUpgradeStateManager:
             ),
             # 7. drain
             lambda: common.process_drain_nodes(state, policy.drain_spec),
-            # 8. node-maintenance (requestor mode only)
+            # 8. node-maintenance, then the post-maintenance gate
+            #    (requestor mode only; the reference declares the state but
+            #    never routes through it — noted at upgrade_state.go:249-250)
             lambda: self._process_node_maintenance_required_nodes_wrapper(state),
+            lambda: self._process_post_maintenance_required_nodes_wrapper(state),
             # 9. pod restart (+ failure detection)
             lambda: common.process_pod_restart_nodes(state),
             # 10. failed-node self-healing, then validation
@@ -331,35 +334,48 @@ class ClusterUpgradeStateManager:
                 # pass against the freshest counts, and each phase sees a
                 # settled bucket (migration never mutates a list mid-
                 # iteration).
+                index = {
+                    ns.node["metadata"]["name"]: ns
+                    for ns in state.all_node_states()
+                    if ns.node is not None
+                }
                 moves: list = []
                 with self._provider.transition_listener(
                     lambda node, new_state: moves.append((node, new_state))
                 ):
                     for phase in phases:
                         phase()
-                        self._migrate_buckets(state, moves)
+                        self._migrate_buckets(state, moves, index)
 
     @staticmethod
-    def _migrate_buckets(state: ClusterUpgradeState, moves: list) -> None:
-        """Move nodes whose state label just changed into their new
-        snapshot bucket (cascade mode only)."""
-        while moves:
-            node, new_state = moves.pop(0)
+    def _migrate_buckets(
+        state: ClusterUpgradeState, moves: list, index: dict
+    ) -> None:
+        """Move nodes whose state label just changed into their new snapshot
+        bucket (cascade mode only).  Batched: one filter pass over the
+        affected buckets per phase instead of a scan per transition, so a
+        pass stays O(fleet) however many nodes cascade."""
+        if not moves:
+            return
+        dest: dict = {}
+        for node, new_state in moves:
             name = (node.get("metadata") or {}).get("name")
-            for bucket, node_states in state.node_states.items():
-                if bucket == new_state:
-                    continue
-                for i, ns in enumerate(node_states):
-                    if (
-                        ns.node is not None
-                        and ns.node["metadata"].get("name") == name
-                    ):
-                        node_states.pop(i)
-                        state.node_states.setdefault(new_state, []).append(ns)
-                        break
+            if name in index:
+                dest[name] = new_state
+        moves.clear()
+        removed = set()
+        for bucket, node_states in list(state.node_states.items()):
+            kept = []
+            for ns in node_states:
+                name = None if ns.node is None else ns.node["metadata"].get("name")
+                if name is not None and dest.get(name, bucket) != bucket:
+                    removed.add(name)
                 else:
-                    continue
-                break
+                    kept.append(ns)
+            if len(kept) != len(node_states):
+                state.node_states[bucket] = kept
+        for name in removed:
+            state.node_states.setdefault(dest[name], []).append(index[name])
 
     # ---------------------------------------------------- mode dispatchers
     def _process_upgrade_required_nodes_wrapper(
@@ -377,6 +393,15 @@ class ClusterUpgradeStateManager:
         """Reference: ProcessNodeMaintenanceRequiredNodesWrapper (:299-309)."""
         if self._use_maintenance_operator and self._requestor is not None:
             self._requestor.process_node_maintenance_required_nodes(state)
+
+    def _process_post_maintenance_required_nodes_wrapper(
+        self, state: ClusterUpgradeState
+    ) -> None:
+        """Post-maintenance gate before the driver-pod restart (requestor
+        mode only; no-op in in-place mode, whose lifecycle never enters
+        the state)."""
+        if self._use_maintenance_operator and self._requestor is not None:
+            self._requestor.process_post_maintenance_required_nodes(state)
 
     def _process_uncordon_required_nodes_wrapper(
         self, state: ClusterUpgradeState

@@ -547,3 +547,190 @@ class TestEnvOpts:
         assert opts.requestor_namespace == "ops"
         assert opts.requestor_id == "tpu-op"
         assert opts.node_maintenance_name_prefix == "myprefix"
+
+
+class TestPostMaintenanceGate:
+    """The state the reference declares but never enters (consts.go:70;
+    TODO at upgrade_state.go:249-250): with a post-maintenance hook
+    installed, maintenance completion routes through
+    post-maintenance-required, and the hook gates the driver-pod restart."""
+
+    def _manager_with_hook(self, cluster, hook):
+        manager = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        opts = RequestorOptions(
+            use_maintenance_operator=True,
+            requestor_id="tpu-gpu-operator",
+            requestor_namespace="default",
+        )
+        requestor = RequestorNodeStateManager(
+            manager.common, opts, post_maintenance_hook=hook
+        )
+        manager.with_requestor(requestor, enabled=True)
+        return manager, requestor
+
+    def _to_maintenance_ready(self, cluster, fleet, manager, policy):
+        mop = FakeMaintenanceOperator(cluster)
+        reconcile(manager, fleet, policy)  # classification
+        reconcile(manager, fleet, policy)  # handoff
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED
+        assert mop.reconcile() == 1
+        return mop
+
+    def test_hook_gates_restart_until_true(self, cluster, fleet):
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        verdicts = [False, True]
+        seen = []
+
+        def hook(node):
+            seen.append(node["metadata"]["name"])
+            return verdicts.pop(0)
+
+        manager, _ = self._manager_with_hook(cluster, hook)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, drain_spec=DrainSpec(enable=True, force=True)
+        )
+        mop = self._to_maintenance_ready(cluster, fleet, manager, policy)
+        # maintenance Ready → post-maintenance-required (hook not yet run:
+        # the node entered the bucket after its phase in this pass)
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_POST_MAINTENANCE_REQUIRED
+        assert seen == []
+        # hook says False → parked; says True → advances to pod-restart
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_POST_MAINTENANCE_REQUIRED
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+        assert seen == ["n1", "n1"]
+        # and the node still finishes the lifecycle
+        for _ in range(8):
+            reconcile(manager, fleet, policy)
+            if fleet.node_state("n1") == consts.UPGRADE_STATE_DONE:
+                break
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_DONE
+
+    def test_hook_exception_parks_and_retries(self, cluster, fleet):
+        """A hook exception must NOT fail the node: the driver pod is still
+        at the old revision here, so the upgrade-failed self-heal (pod back
+        in sync) could never fire and the node would wedge.  Transient
+        probe errors park and retry instead."""
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        calls = []
+
+        def hook(node):
+            calls.append(1)
+            if len(calls) < 2:
+                raise RuntimeError("ICI link check timed out")
+            return True
+
+        manager, _ = self._manager_with_hook(cluster, hook)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, drain_spec=DrainSpec(enable=True, force=True)
+        )
+        self._to_maintenance_ready(cluster, fleet, manager, policy)
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_POST_MAINTENANCE_REQUIRED
+        # exception → parked, not failed
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_POST_MAINTENANCE_REQUIRED
+        # next probe succeeds → advances
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+
+    def test_cascade_with_requestor_same_pass_gate(self, cluster, fleet):
+        """Cascade + requestor interaction: the Ready transition migrates
+        the node into the post-maintenance bucket mid-pass, so the hook
+        runs (and can release) in the SAME reconcile that observed
+        readiness; admission likewise cascades into CR creation."""
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        hook_calls = []
+
+        def hook(node):
+            hook_calls.append(node["metadata"]["name"])
+            return True
+
+        manager = ClusterUpgradeStateManager(
+            cluster,
+            cascade=True,
+            cache_sync_timeout_seconds=2.0,
+            cache_sync_poll_seconds=0.01,
+        )
+        opts = RequestorOptions(
+            use_maintenance_operator=True,
+            requestor_id="tpu-gpu-operator",
+            requestor_namespace="default",
+        )
+        requestor = RequestorNodeStateManager(
+            manager.common, opts, post_maintenance_hook=hook
+        )
+        manager.with_requestor(requestor, enabled=True)
+        mop = FakeMaintenanceOperator(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, drain_spec=DrainSpec(enable=True, force=True)
+        )
+        # pass 1: classification cascades into admission + CR handoff
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED
+        assert requestor.get_node_maintenance_obj("n1") is not None
+        # external operator completes maintenance
+        assert mop.reconcile() == 1
+        # pass 2: Ready observed → post-maintenance → hook → pod-restart,
+        # all in one pass
+        reconcile(manager, fleet, policy)
+        assert hook_calls == ["n1"]
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+        # and the lifecycle still completes
+        for _ in range(8):
+            reconcile(manager, fleet, policy)
+            if fleet.node_state("n1") == consts.UPGRADE_STATE_DONE:
+                break
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_DONE
+        assert not util.is_node_in_requestor_mode(cluster.get("Node", "n1"))
+
+    def test_no_hook_passes_state_through(self, cluster, fleet):
+        """A resumed fleet whose labels already carry the state (e.g. the
+        hook was removed across an operator restart) must not wedge."""
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager, _ = make_requestor_manager(cluster)
+        cluster.patch(
+            "Node",
+            "n1",
+            {
+                "metadata": {
+                    "labels": {
+                        util.get_upgrade_state_label_key(): (
+                            consts.UPGRADE_STATE_POST_MAINTENANCE_REQUIRED
+                        )
+                    },
+                    "annotations": {
+                        util.get_upgrade_requestor_mode_annotation_key(): "true"
+                    },
+                }
+            },
+        )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, drain_spec=DrainSpec(enable=True, force=True)
+        )
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+
+    def test_without_hook_reference_shortcut_taken(self, cluster, fleet):
+        """No hook installed → the reference's direct
+        node-maintenance-required → pod-restart-required transition."""
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager, _ = make_requestor_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, drain_spec=DrainSpec(enable=True, force=True)
+        )
+        mop = FakeMaintenanceOperator(cluster)
+        reconcile(manager, fleet, policy)
+        reconcile(manager, fleet, policy)
+        assert mop.reconcile() == 1
+        reconcile(manager, fleet, policy)
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
