@@ -1,0 +1,387 @@
+"""Prometheus-style metrics for the upgrade state machine.
+
+The reference has **no metrics** — its prometheus deps are indirect only
+and the one aggregate-progress event is commented out
+(SURVEY.md §5: upgrade_state.go:199-202).  Operators running TPU fleets
+need more than Events to alert on a stuck rollout, so this module
+supplies the standard trio (counter / gauge / histogram) with label
+support and text exposition in the Prometheus format, wired into:
+
+* :class:`~.upgrade.node_upgrade_state_provider.NodeUpgradeStateProvider`
+  — ``upgrade_state_transitions_total{to_state=...}``;
+* :class:`~.upgrade.upgrade_state.ClusterUpgradeStateManager` —
+  ``reconcile_seconds{phase=build|apply}`` and the rollout gauges
+  ``nodes_in_state{state=...}``, ``upgrades_{in_progress,pending,failed,done}``,
+  ``managed_nodes``;
+* :class:`~.upgrade.drain_manager.DrainManager` —
+  ``drains_total{result=...}`` and ``drain_seconds``.
+
+Everything records into a process-default :class:`MetricsRegistry`
+(swappable for tests via :func:`set_default_registry`); recording is a
+dict update under a lock, cheap enough to stay always-on.  Serving the
+text over HTTP is the consumer's choice (any WSGI one-liner around
+:meth:`MetricsRegistry.render`); this library stays transport-free the
+same way the reference stays logr-only.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_PREFIX = "k8s_operator_libs_tpu_"
+
+#: Default histogram buckets — seconds, tuned for control-plane latencies
+#: (cache-visibility waits are ~1 s scale, drains minutes scale).
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
+
+LabelValues = Tuple[str, ...]
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _format_labels(names: Sequence[str], values: LabelValues,
+                   extra: Optional[Tuple[str, str]] = None) -> str:
+    pairs = [f'{n}="{_escape(v)}"' for n, v in zip(names, values)]
+    if extra is not None:
+        pairs.append(f'{extra[0]}="{_escape(extra[1])}"')
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _format_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Metric:
+    """Shared labeled-series bookkeeping for all three metric types."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, labelnames: Sequence[str] = ()) -> None:
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    def _check(self, labels: LabelValues) -> LabelValues:
+        if len(labels) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got {labels}"
+            )
+        return tuple(str(v) for v in labels)
+
+    def render(self) -> List[str]:  # pragma: no cover — overridden
+        raise NotImplementedError
+
+    def _header(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+
+
+class Counter(_Metric):
+    """Monotonically increasing count, optionally labeled."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str, labelnames: Sequence[str] = ()) -> None:
+        super().__init__(name, help_, labelnames)
+        self._values: Dict[LabelValues, float] = {}
+
+    def inc(self, *labels: str, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = self._check(tuple(labels))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, *labels: str) -> float:
+        key = self._check(tuple(labels))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        lines = self._header()
+        for labels, v in items:
+            lines.append(
+                f"{self.name}{_format_labels(self.labelnames, labels)} "
+                f"{_format_value(v)}"
+            )
+        return lines
+
+
+class Gauge(_Metric):
+    """Point-in-time value, optionally labeled."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str, labelnames: Sequence[str] = ()) -> None:
+        super().__init__(name, help_, labelnames)
+        self._values: Dict[LabelValues, float] = {}
+
+    def set(self, value: float, *labels: str) -> None:
+        key = self._check(tuple(labels))
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, *labels: str, amount: float = 1.0) -> None:
+        key = self._check(tuple(labels))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, *labels: str, amount: float = 1.0) -> None:
+        self.inc(*labels, amount=-amount)
+
+    def value(self, *labels: str) -> float:
+        key = self._check(tuple(labels))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def clear(self) -> None:
+        """Drop every labeled series."""
+        with self._lock:
+            self._values.clear()
+
+    def replace(self, values: Dict[LabelValues, float]) -> None:
+        """Atomically swap the whole family (re-published each reconcile so
+        emptied states disappear without a concurrent scrape ever seeing a
+        half-cleared family)."""
+        checked = {
+            self._check(k): float(v) for k, v in values.items()
+        }
+        with self._lock:
+            self._values = checked
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        lines = self._header()
+        for labels, v in items:
+            lines.append(
+                f"{self.name}{_format_labels(self.labelnames, labels)} "
+                f"{_format_value(v)}"
+            )
+        return lines
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket
+    counts observations ≤ its upper bound; ``+Inf`` mirrors ``_count``)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> None:
+        super().__init__(name, help_, labelnames)
+        # +Inf is implicit (rendered from _count); a user-supplied inf
+        # bound would emit a duplicate le="+Inf" series, so drop it.
+        self.buckets = tuple(
+            sorted(float(b) for b in buckets if float(b) != float("inf"))
+        )
+        if not self.buckets:
+            raise ValueError("histogram needs at least one finite bucket")
+        # per-labelset: (bucket counts, total count, sum)
+        self._series: Dict[LabelValues, Tuple[List[int], int, float]] = {}
+
+    def observe(self, value: float, *labels: str) -> None:
+        key = self._check(tuple(labels))
+        with self._lock:
+            counts, count, total = self._series.get(
+                key, ([0] * len(self.buckets), 0, 0.0)
+            )
+            counts = list(counts)
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+            self._series[key] = (counts, count + 1, total + float(value))
+
+    def count(self, *labels: str) -> int:
+        key = self._check(tuple(labels))
+        with self._lock:
+            return self._series.get(key, ([], 0, 0.0))[1]
+
+    def sum(self, *labels: str) -> float:
+        key = self._check(tuple(labels))
+        with self._lock:
+            return self._series.get(key, ([], 0, 0.0))[2]
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted(
+                (k, (list(c), n, s)) for k, (c, n, s) in self._series.items()
+            )
+        lines = self._header()
+        for labels, (counts, count, total) in items:
+            for bound, c in zip(self.buckets, counts):
+                le = _format_value(bound)
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_format_labels(self.labelnames, labels, ('le', le))} {c}"
+                )
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_format_labels(self.labelnames, labels, ('le', '+Inf'))} {count}"
+            )
+            lines.append(
+                f"{self.name}_sum{_format_labels(self.labelnames, labels)} "
+                f"{_format_value(total)}"
+            )
+            lines.append(
+                f"{self.name}_count{_format_labels(self.labelnames, labels)} {count}"
+            )
+        return lines
+
+
+class MetricsRegistry:
+    """Create-or-get metric families and render them as Prometheus text."""
+
+    def __init__(self, prefix: str = _PREFIX) -> None:
+        self._prefix = prefix
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help_: str,
+                       labelnames: Sequence[str], **kwargs) -> _Metric:
+        full = self._prefix + name
+        with self._lock:
+            existing = self._metrics.get(full)
+            if existing is not None:
+                if not isinstance(existing, cls) or existing.labelnames != tuple(
+                    labelnames
+                ):
+                    raise ValueError(
+                        f"metric {full} re-registered with a different "
+                        f"type/labels"
+                    )
+                wanted_buckets = kwargs.get("buckets")
+                if wanted_buckets is not None and isinstance(existing, Histogram):
+                    normalized = tuple(
+                        sorted(
+                            float(b)
+                            for b in wanted_buckets
+                            if float(b) != float("inf")
+                        )
+                    )
+                    if normalized != existing.buckets:
+                        raise ValueError(
+                            f"metric {full} re-registered with different "
+                            f"buckets"
+                        )
+                return existing
+            metric = cls(full, help_, labelnames, **kwargs)
+            self._metrics[full] = metric
+            return metric
+
+    def counter(self, name: str, help_: str,
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help_, labelnames)
+
+    def gauge(self, name: str, help_: str,
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_, labelnames)
+
+    def histogram(self, name: str, help_: str, labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help_, labelnames, buckets=buckets
+        )
+
+    def collect(self) -> Iterable[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def render(self) -> str:
+        """The full registry in Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        for metric in sorted(self.collect(), key=lambda m: m.name):
+            lines.extend(metric.render())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_default_registry = MetricsRegistry()
+_default_lock = threading.Lock()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry every component records into."""
+    with _default_lock:
+        return _default_registry
+
+
+def set_default_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process-default registry (tests); returns the previous."""
+    global _default_registry
+    with _default_lock:
+        previous = _default_registry
+        _default_registry = registry
+        return previous
+
+
+# --------------------------------------------------------------- wiring API
+# Components call these helpers rather than holding metric objects, so the
+# registry can be swapped at any time and the callsites stay one-liners.
+
+def record_state_transition(to_state: str) -> None:
+    default_registry().counter(
+        "upgrade_state_transitions_total",
+        "Node upgrade-state label transitions, by destination state.",
+        ("to_state",),
+    ).inc(to_state or "unknown")
+
+
+def observe_reconcile(phase: str, seconds: float) -> None:
+    default_registry().histogram(
+        "reconcile_seconds",
+        "Duration of state-machine phases (build_state / apply_state).",
+        ("phase",),
+    ).observe(seconds, phase)
+
+
+def record_drain(result: str, seconds: float) -> None:
+    reg = default_registry()
+    reg.counter(
+        "drains_total", "Completed node drains, by result.", ("result",)
+    ).inc(result)
+    reg.histogram(
+        "drain_seconds", "Wall-clock duration of node drains."
+    ).observe(seconds)
+
+
+def publish_rollout_gauges(
+    per_state: Dict[str, int],
+    total: int,
+    in_progress: int,
+    pending: int,
+    failed: int,
+    done: int,
+) -> None:
+    reg = default_registry()
+    reg.gauge(
+        "nodes_in_state", "Managed nodes per upgrade state.", ("state",)
+    ).replace({(state or "unknown",): count for state, count in per_state.items()})
+    reg.gauge("managed_nodes", "Total nodes managed by the rollout.").set(total)
+    reg.gauge("upgrades_in_progress", "Nodes in an active upgrade state.").set(
+        in_progress
+    )
+    reg.gauge("upgrades_pending", "Nodes waiting for an upgrade slot.").set(
+        pending
+    )
+    reg.gauge("upgrades_failed", "Nodes in upgrade-failed.").set(failed)
+    reg.gauge("upgrades_done", "Nodes at the target revision.").set(done)

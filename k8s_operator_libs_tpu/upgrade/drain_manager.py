@@ -28,6 +28,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Protocol, Tuple
 
+from .. import metrics
 from ..api.upgrade_spec import DrainSpec
 from ..cluster.errors import NotFoundError
 from ..cluster.inmem import InMemoryCluster, JsonObj
@@ -233,6 +234,7 @@ class DrainManager:
     # ------------------------------------------------------------- internals
     def _drain_one(self, node: JsonObj, spec: DrainSpec) -> None:
         name = name_of(node)
+        started = time.monotonic()
         try:
             # Cordon first (kubectl drain always cordons).
             self._cordon_manager.cordon(node)
@@ -261,8 +263,10 @@ class DrainManager:
                 util.get_event_reason(),
                 f"Failed to drain node: {err}",
             )
+            metrics.record_drain("failed", time.monotonic() - started)
             self._finish(node, consts.UPGRADE_STATE_FAILED)
             return
+        metrics.record_drain("ok", time.monotonic() - started)
         log_event(
             self._recorder,
             name,

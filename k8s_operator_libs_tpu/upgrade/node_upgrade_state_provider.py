@@ -20,6 +20,7 @@ import time
 from contextlib import contextmanager
 from typing import Iterator, List, Optional, Tuple
 
+from .. import metrics
 from ..cluster.cache import InformerCache
 from ..cluster.errors import NotFoundError
 from ..cluster.inmem import InMemoryCluster, JsonObj
@@ -101,6 +102,7 @@ class NodeUpgradeStateProvider:
             node["metadata"]["labels"].pop(key, None)
         else:
             node["metadata"]["labels"][key] = new_state
+        metrics.record_state_transition(new_state)
         listener = getattr(self._local, "listener", None)
         if listener is not None:
             listener(node, new_state)

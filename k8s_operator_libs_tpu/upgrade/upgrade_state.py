@@ -22,8 +22,10 @@ Reference parity: ``pkg/upgrade/upgrade_state.go`` (C1) —
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
+from .. import metrics
 from ..api.upgrade_spec import UpgradePolicySpec
 from ..cluster.cache import InformerCache
 from ..cluster.errors import NotFoundError
@@ -176,6 +178,17 @@ class ClusterUpgradeStateManager:
         self, namespace: str, driver_labels: Dict[str, str]
     ) -> ClusterUpgradeState:
         """Snapshot construction (reference: BuildState, :99-164)."""
+        started = time.monotonic()
+        try:
+            return self._build_state(namespace, driver_labels)
+        finally:
+            # finally: failed snapshots are exactly the slow outliers the
+            # latency histogram exists to surface
+            metrics.observe_reconcile("build", time.monotonic() - started)
+
+    def _build_state(
+        self, namespace: str, driver_labels: Dict[str, str]
+    ) -> ClusterUpgradeState:
         common = self.common
         state = ClusterUpgradeState()
         daemon_sets = common.get_driver_daemon_sets(namespace, driver_labels)
@@ -245,11 +258,45 @@ class ClusterUpgradeStateManager:
         """The 11-phase hot loop (reference: ApplyState, :171-281)."""
         if state is None:
             raise UpgradeStateError("currentState should not be empty")
+        common = self.common
         if policy is None or not policy.auto_upgrade:
+            # Still re-publish the rollout gauges from the fresh snapshot:
+            # a paused rollout must not leave upgrades_in_progress frozen
+            # at its last active value (alerts would fire forever).
+            self._publish_gauges(common, state)
             logger.info("auto upgrade is disabled, skipping")
             return
-        common = self.common
+        started = time.monotonic()
+        try:
+            self._apply_state(common, state, policy)
+        finally:
+            # finally: an aborted reconcile (e.g. cache-sync timeout) is
+            # the latency outlier the histogram must not silently drop
+            metrics.observe_reconcile("apply", time.monotonic() - started)
 
+    @staticmethod
+    def _publish_gauges(
+        common: CommonUpgradeManager, state: ClusterUpgradeState
+    ) -> Tuple[int, int, int]:
+        in_progress = common.get_upgrades_in_progress(state)
+        pending = common.get_upgrades_pending(state)
+        failed = common.get_upgrades_failed(state)
+        metrics.publish_rollout_gauges(
+            {k: len(v) for k, v in state.node_states.items()},
+            common.get_total_managed_nodes(state),
+            in_progress,
+            pending,
+            failed,
+            common.get_upgrades_done(state),
+        )
+        return in_progress, pending, failed
+
+    def _apply_state(
+        self,
+        common: CommonUpgradeManager,
+        state: ClusterUpgradeState,
+        policy: UpgradePolicySpec,
+    ) -> None:
         logger.info(
             "node states: %s",
             {k or "unknown": len(v) for k, v in state.node_states.items()},
@@ -258,9 +305,7 @@ class ClusterUpgradeStateManager:
         # it commented out (upgrade_state.go:199-202); here it is live,
         # gated on an active rollout so a steady-state fleet doesn't spam
         # identical events into a real sink every reconcile.
-        in_progress = common.get_upgrades_in_progress(state)
-        pending = common.get_upgrades_pending(state)
-        failed = common.get_upgrades_failed(state)
+        in_progress, pending, failed = self._publish_gauges(common, state)
         if in_progress or pending or failed:
             log_event(
                 self._recorder,

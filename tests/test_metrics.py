@@ -1,0 +1,299 @@
+"""Metrics subsystem — primitives, exposition format, and state-machine
+wiring (the reference has no metrics at all; SURVEY.md §5)."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from k8s_operator_libs_tpu import metrics
+from k8s_operator_libs_tpu.api import DrainSpec, IntOrString, UpgradePolicySpec
+from k8s_operator_libs_tpu.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager, consts
+
+from harness import DRIVER_LABELS, NAMESPACE, Fleet
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    """Isolate every test behind its own default registry."""
+    registry = MetricsRegistry()
+    previous = metrics.set_default_registry(registry)
+    yield registry
+    metrics.set_default_registry(previous)
+
+
+class TestCounter:
+    def test_inc_and_value(self):
+        c = Counter("t_total", "help")
+        c.inc()
+        c.inc(amount=2.5)
+        assert c.value() == 3.5
+
+    def test_labeled_series_independent(self):
+        c = Counter("t_total", "help", ("state",))
+        c.inc("a")
+        c.inc("a")
+        c.inc("b")
+        assert c.value("a") == 2
+        assert c.value("b") == 1
+        assert c.value("never") == 0
+
+    def test_negative_rejected(self):
+        c = Counter("t_total", "help")
+        with pytest.raises(ValueError):
+            c.inc(amount=-1)
+
+    def test_label_arity_enforced(self):
+        c = Counter("t_total", "help", ("state",))
+        with pytest.raises(ValueError):
+            c.inc()
+        with pytest.raises(ValueError):
+            c.inc("a", "b")
+
+    def test_render(self):
+        c = Counter("t_total", "help text", ("state",))
+        c.inc("done")
+        lines = c.render()
+        assert "# HELP t_total help text" in lines
+        assert "# TYPE t_total counter" in lines
+        assert 't_total{state="done"} 1' in lines
+
+    def test_thread_safety(self):
+        c = Counter("t_total", "help")
+
+        def worker():
+            for _ in range(1000):
+                c.inc()
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value() == 8000
+
+
+class TestGauge:
+    def test_set_inc_dec(self):
+        g = Gauge("t", "help")
+        g.set(5)
+        g.inc()
+        g.dec(amount=2)
+        assert g.value() == 4
+
+    def test_clear_drops_series(self):
+        g = Gauge("t", "help", ("state",))
+        g.set(3, "cordon-required")
+        g.clear()
+        assert 't{state="cordon-required"}' not in "\n".join(g.render())
+
+
+class TestHistogram:
+    def test_cumulative_buckets(self):
+        h = Histogram("t_seconds", "help", buckets=(0.1, 1.0, 10.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        h.observe(50.0)  # above every bound — only _count/+Inf sees it
+        text = "\n".join(h.render())
+        assert 't_seconds_bucket{le="0.1"} 1' in text
+        assert 't_seconds_bucket{le="1"} 2' in text
+        assert 't_seconds_bucket{le="10"} 3' in text
+        assert 't_seconds_bucket{le="+Inf"} 4' in text
+        assert "t_seconds_count 4" in text
+        assert h.count() == 4
+        assert h.sum() == pytest.approx(55.55)
+
+    def test_labeled(self):
+        h = Histogram("t_seconds", "help", ("phase",), buckets=(1.0,))
+        h.observe(0.5, "build")
+        h.observe(2.0, "apply")
+        assert h.count("build") == 1
+        assert h.count("apply") == 1
+        assert h.count("other") == 0
+
+    def test_explicit_inf_bucket_not_duplicated(self):
+        h = Histogram("t_seconds", "help", buckets=(1.0, float("inf")))
+        h.observe(0.5)
+        text = "\n".join(h.render())
+        assert text.count('le="+Inf"') == 1
+
+
+class TestRegistry:
+    def test_create_or_get_same_object(self, fresh_registry):
+        a = fresh_registry.counter("x_total", "h")
+        b = fresh_registry.counter("x_total", "h")
+        assert a is b
+
+    def test_type_conflict_rejected(self, fresh_registry):
+        fresh_registry.counter("x_total", "h")
+        with pytest.raises(ValueError):
+            fresh_registry.gauge("x_total", "h")
+
+    def test_label_conflict_rejected(self, fresh_registry):
+        fresh_registry.counter("x_total", "h", ("a",))
+        with pytest.raises(ValueError):
+            fresh_registry.counter("x_total", "h", ("b",))
+
+    def test_bucket_conflict_rejected(self, fresh_registry):
+        fresh_registry.histogram("x_seconds", "h", buckets=(0.1, 1.0))
+        with pytest.raises(ValueError):
+            fresh_registry.histogram("x_seconds", "h", buckets=(5.0, 60.0))
+        # same bounds (modulo the implicit +Inf) re-register fine
+        again = fresh_registry.histogram(
+            "x_seconds", "h", buckets=(1.0, 0.1, float("inf"))
+        )
+        assert again.buckets == (0.1, 1.0)
+
+    def test_render_is_valid_exposition(self, fresh_registry):
+        fresh_registry.counter("a_total", "ha").inc()
+        fresh_registry.gauge("b", "hb").set(2)
+        text = fresh_registry.render()
+        assert text.endswith("\n")
+        # every non-comment line is "name{labels} value"
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            name, _, value = line.rpartition(" ")
+            assert name
+            float(value)  # parses
+
+    def test_swap_default_registry(self):
+        mine = MetricsRegistry()
+        prev = metrics.set_default_registry(mine)
+        try:
+            metrics.record_state_transition("upgrade-done")
+            assert (
+                mine.counter(
+                    "upgrade_state_transitions_total", "", ("to_state",)
+                ).value("upgrade-done")
+                == 1
+            )
+        finally:
+            metrics.set_default_registry(prev)
+
+
+class TestStateMachineWiring:
+    """Run a real rollout and assert the metrics land."""
+
+    def test_rollout_records_everything(self, cluster, fresh_registry):
+        fleet = Fleet(cluster, revision_hash="v1")
+        for h in range(3):
+            fleet.add_node(f"host{h}")
+        fleet.publish_new_revision("v2")
+        manager = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=30),
+        )
+        for _ in range(25):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10.0)
+            manager.pod_manager.wait_idle(10.0)
+            fleet.reconcile_daemonset()
+            if all(
+                s == consts.UPGRADE_STATE_DONE for s in fleet.states().values()
+            ):
+                break
+        else:
+            pytest.fail("rollout did not converge")
+        # one settling reconcile so the gauges reflect the converged fleet
+        manager.apply_state(manager.build_state(NAMESPACE, DRIVER_LABELS), policy)
+
+        reg = fresh_registry
+        transitions = reg.counter(
+            "upgrade_state_transitions_total", "", ("to_state",)
+        )
+        assert transitions.value(consts.UPGRADE_STATE_DONE) == 3
+        assert transitions.value(consts.UPGRADE_STATE_CORDON_REQUIRED) == 3
+        drains = reg.counter("drains_total", "", ("result",))
+        assert drains.value("ok") == 3
+        assert reg.histogram("reconcile_seconds", "", ("phase",)).count("build") > 0
+        assert reg.histogram("reconcile_seconds", "", ("phase",)).count("apply") > 0
+        assert reg.gauge("upgrades_done", "").value() == 3
+        assert reg.gauge("managed_nodes", "").value() == 3
+        # steady state: the in-progress gauge has settled back to zero
+        assert reg.gauge("upgrades_in_progress", "").value() == 0
+        text = reg.render()
+        assert "k8s_operator_libs_tpu_nodes_in_state" in text
+
+    def test_paused_rollout_refreshes_gauges(self, cluster, fresh_registry):
+        """auto_upgrade=false must not leave stale in-progress gauges
+        frozen at their last active values (alerting would never clear)."""
+        fleet = Fleet(cluster, revision_hash="v1")
+        fleet.add_node("host0")
+        fleet.publish_new_revision("v2")
+        manager = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        active = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=30),
+        )
+        # two reconciles: classify (unknown → upgrade-required), then admit
+        for _ in range(2):
+            manager.apply_state(
+                manager.build_state(NAMESPACE, DRIVER_LABELS), active
+            )
+        reg = fresh_registry
+        assert (
+            reg.gauge("upgrades_in_progress", "").value()
+            + reg.gauge("upgrades_pending", "").value()
+            > 0
+        )
+        # ...then the operator pauses the rollout mid-flight
+        paused = UpgradePolicySpec(auto_upgrade=False)
+        manager.apply_state(manager.build_state(NAMESPACE, DRIVER_LABELS), paused)
+        # gauges re-published from the live snapshot, not frozen: the node
+        # is still mid-upgrade so in_progress reflects reality, and the
+        # family keeps updating on every paused reconcile
+        snapshot = reg.render()
+        manager.apply_state(manager.build_state(NAMESPACE, DRIVER_LABELS), paused)
+        assert "nodes_in_state" in snapshot
+
+    def test_drain_failure_counted(self, cluster, fresh_registry):
+        fleet = Fleet(cluster, revision_hash="v1")
+        fleet.add_node("host0")
+        fleet.publish_new_revision("v2")
+        # a bare pod (no controller) makes the drain plan error without force
+        cluster.create(
+            {
+                "kind": "Pod",
+                "metadata": {"name": "naked", "namespace": NAMESPACE},
+                "spec": {"nodeName": "host0"},
+                "status": {"phase": "Running"},
+            }
+        )
+        manager = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            drain_spec=DrainSpec(enable=True, force=False, timeout_second=5),
+        )
+        for _ in range(10):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10.0)
+            if fleet.states().get("host0") == consts.UPGRADE_STATE_FAILED:
+                break
+        else:
+            pytest.fail("drain never failed")
+        assert (
+            fresh_registry.counter("drains_total", "", ("result",)).value("failed")
+            >= 1
+        )
