@@ -1,0 +1,34 @@
+"""Controller runtime: rate-limited workqueue + watch-driven reconcile
+loop (the controller-runtime analog the reference assumes upstream)."""
+
+from .controller import (
+    Controller,
+    Reconciler,
+    Request,
+    Result,
+)
+from .upgrade_reconciler import (
+    UPGRADE_REQUEST,
+    UpgradeReconciler,
+    new_upgrade_controller,
+)
+from .workqueue import (
+    ExponentialBackoffRateLimiter,
+    RateLimitedQueue,
+    ShutDown,
+    WorkQueue,
+)
+
+__all__ = [
+    "Controller",
+    "Reconciler",
+    "Request",
+    "Result",
+    "UPGRADE_REQUEST",
+    "UpgradeReconciler",
+    "new_upgrade_controller",
+    "ExponentialBackoffRateLimiter",
+    "RateLimitedQueue",
+    "ShutDown",
+    "WorkQueue",
+]

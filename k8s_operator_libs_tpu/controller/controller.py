@@ -1,0 +1,290 @@
+"""Watch-driven reconcile loop — the controller-runtime analog.
+
+The reference is a *library linked into* controllers built on
+``sigs.k8s.io/controller-runtime`` (SURVEY.md L5/L1): something else
+watches the apiserver, maps events onto a rate-limited workqueue, and
+calls a ``Reconcile(request)`` function with retry/backoff.  This module
+supplies that missing runtime over the in-memory apiserver so the
+library is standalone:
+
+* :class:`Controller` runs one watch thread per instance consuming the
+  cluster's journal (``events_since``), recovering from journal expiry
+  (the 410 Gone analog) with a **relist** — exactly the informer
+  list/watch contract;
+* events pass through optional per-watch **predicates** (e.g. the
+  requestor mode's ``ConditionChangedPredicate``) and a **mapper** from
+  object to request keys (the ``handler.EnqueueRequestsFromMapFunc``
+  analog);
+* worker threads pull requests off a :class:`~.workqueue.RateLimitedQueue`
+  and call the :class:`Reconciler`; an exception or ``Result(requeue=True)``
+  re-enqueues with per-item exponential backoff, ``requeue_after`` sets
+  an exact delay, success forgets the item's failure history;
+* a **periodic resync** re-enqueues every mapped object so state drift
+  with no triggering event (e.g. an async drain worker label write whose
+  event raced a relist) is still reconciled — this is the operator
+  "requeue cycle" the reference's async managers rely on
+  (SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Hashable, Iterable, List, Optional, Protocol
+
+from ..cluster.errors import ExpiredError
+from ..cluster.inmem import InMemoryCluster, JsonObj, WatchEvent
+from .workqueue import RateLimitedQueue, ShutDown
+
+logger = logging.getLogger(__name__)
+
+#: Maps a changed object to the request keys it should enqueue.
+RequestMapper = Callable[[JsonObj], Iterable[Hashable]]
+#: Event filter; False drops the event before mapping.
+Predicate = Callable[[WatchEvent], bool]
+
+
+@dataclass(frozen=True)
+class Request:
+    """Default request key: one object (controller-runtime's
+    reconcile.Request carries namespace/name; kind is added here because
+    this substrate is not typed per-controller)."""
+
+    kind: str
+    name: str
+    namespace: str = ""
+
+
+@dataclass
+class Result:
+    """Reconciler verdict (controller-runtime's reconcile.Result)."""
+
+    requeue: bool = False
+    requeue_after: float = 0.0
+
+
+class Reconciler(Protocol):
+    def reconcile(self, request: Hashable) -> Optional[Result]: ...
+
+
+def _default_mapper(obj: JsonObj) -> Iterable[Hashable]:
+    meta = obj.get("metadata") or {}
+    return [
+        Request(
+            kind=obj.get("kind", ""),
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", ""),
+        )
+    ]
+
+
+@dataclass
+class _Watch:
+    kind: str
+    predicate: Optional[Predicate] = None
+    mapper: RequestMapper = field(default=_default_mapper)
+
+
+class Controller:
+    """One reconciler + its watches + the queue + worker threads."""
+
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        reconciler: Reconciler,
+        *,
+        name: str = "controller",
+        watch_poll_seconds: float = 0.005,
+        resync_seconds: float = 0.0,
+        max_retries: Optional[int] = None,
+        queue: Optional[RateLimitedQueue] = None,
+    ) -> None:
+        self._cluster = cluster
+        self._reconciler = reconciler
+        self.name = name
+        self._poll = watch_poll_seconds
+        self._resync = resync_seconds
+        self._max_retries = max_retries
+        self._queue = queue or RateLimitedQueue()
+        self._watches: List[_Watch] = []
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._started = False
+        #: requests whose retry budget ran out (observable for tests/ops)
+        self.dropped: List[Hashable] = []
+
+    # -------------------------------------------------------------- assembly
+    def watches(
+        self,
+        kind: str,
+        predicate: Optional[Predicate] = None,
+        mapper: Optional[RequestMapper] = None,
+    ) -> "Controller":
+        """Register interest in a kind (controller-runtime ``Watches``)."""
+        if self._started:
+            raise RuntimeError("add watches before start()")
+        self._watches.append(
+            _Watch(kind=kind, predicate=predicate, mapper=mapper or _default_mapper)
+        )
+        return self
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self, workers: int = 1) -> None:
+        if self._started:
+            raise RuntimeError("controller already started")
+        if not self._watches:
+            raise RuntimeError("controller has no watches")
+        self._started = True
+        self._enqueue_initial_list()
+        watcher = threading.Thread(
+            target=self._watch_loop, name=f"{self.name}-watch", daemon=True
+        )
+        watcher.start()
+        self._threads.append(watcher)
+        if self._resync > 0:
+            resyncer = threading.Thread(
+                target=self._resync_loop, name=f"{self.name}-resync", daemon=True
+            )
+            resyncer.start()
+            self._threads.append(resyncer)
+        for i in range(workers):
+            w = threading.Thread(
+                target=self._worker_loop, name=f"{self.name}-worker-{i}", daemon=True
+            )
+            w.start()
+            self._threads.append(w)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Graceful: stop watching, drain workers, join threads."""
+        self._stop.set()
+        self._queue.shutdown()
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+
+    def wait_quiet(self, timeout: float = 10.0, settle: float = 0.05) -> bool:
+        """Test helper: wait until there is no work at all — queued, being
+        processed, or sitting in the delay heap — for *settle* seconds."""
+        deadline = time.monotonic() + timeout
+        quiet_since: Optional[float] = None
+        while time.monotonic() < deadline:
+            if self._queue.pending_work() == 0:
+                if quiet_since is None:
+                    quiet_since = time.monotonic()
+                elif time.monotonic() - quiet_since >= settle:
+                    return True
+            else:
+                quiet_since = None
+            time.sleep(0.005)
+        return False
+
+    # ------------------------------------------------------------- internals
+    def _enqueue_initial_list(self) -> int:
+        """List every watched kind and enqueue (the informer's initial
+        list; also the relist path after journal expiry)."""
+        seq = self._cluster.journal_seq()
+        for watch in self._watches:
+            for obj in self._cluster.list(watch.kind):
+                for request in watch.mapper(obj):
+                    self._queue.add(request)
+        self._last_seq = seq
+        return seq
+
+    def _watch_loop(self) -> None:
+        # The loop must outlive ANY exception: a dead watch thread is a
+        # controller that silently never reconciles again.  Journal expiry
+        # relists; a user predicate/mapper raising on one event drops that
+        # event (logged — the periodic resync covers the drift; retrying a
+        # deterministic mapper bug forever would hot-loop the same error);
+        # transient store errors retry next poll without losing position.
+        while not self._stop.is_set():
+            try:
+                events = self._cluster.events_since(self._last_seq)
+            except ExpiredError:
+                # 410 Gone: the journal no longer holds our position —
+                # relist everything rather than silently missing events.
+                logger.info("%s: watch expired, relisting", self.name)
+                self._safe_relist()
+                self._stop.wait(self._poll)
+                continue
+            except Exception as err:  # noqa: BLE001 — thread boundary
+                logger.error("%s: watch poll failed: %s", self.name, err)
+                self._stop.wait(self._poll)
+                continue
+            for event in events:
+                try:
+                    self._fan_out(event)
+                except Exception as err:  # noqa: BLE001 — thread boundary
+                    logger.error(
+                        "%s: dropping event seq=%d after handler error: %s",
+                        self.name, event.seq, err,
+                    )
+                self._last_seq = max(self._last_seq, event.seq)
+            self._stop.wait(self._poll)
+
+    def _fan_out(self, event: WatchEvent) -> None:
+        obj = event.new or event.old
+        if obj is None:
+            return
+        kind = obj.get("kind")
+        for watch in self._watches:
+            if watch.kind != kind:
+                continue
+            if watch.predicate is not None and not watch.predicate(event):
+                continue
+            for request in watch.mapper(obj):
+                self._queue.add(request)
+
+    def _safe_relist(self) -> None:
+        try:
+            self._enqueue_initial_list()
+        except Exception as err:  # noqa: BLE001 — thread boundary
+            logger.error("%s: relist failed: %s", self.name, err)
+
+    def _resync_loop(self) -> None:
+        while not self._stop.wait(self._resync):
+            try:
+                for watch in self._watches:
+                    for obj in self._cluster.list(watch.kind):
+                        for request in watch.mapper(obj):
+                            self._queue.add(request)
+            except Exception as err:  # noqa: BLE001 — thread boundary
+                logger.error("%s: resync failed: %s", self.name, err)
+
+    def _worker_loop(self) -> None:
+        while True:
+            try:
+                request = self._queue.get(timeout=0.5)
+            except ShutDown:
+                return
+            if request is None:
+                continue
+            try:
+                result = self._reconciler.reconcile(request)
+            except Exception as err:  # noqa: BLE001 — worker boundary
+                retries = self._queue.num_requeues(request)
+                if self._max_retries is not None and retries >= self._max_retries:
+                    logger.error(
+                        "%s: giving up on %r after %d retries: %s",
+                        self.name, request, retries, err,
+                    )
+                    self._queue.forget(request)
+                    self.dropped.append(request)
+                else:
+                    logger.warning(
+                        "%s: reconcile of %r failed (retry %d): %s",
+                        self.name, request, retries + 1, err,
+                    )
+                    self._queue.add_rate_limited(request)
+                self._queue.done(request)
+                continue
+            if result is not None and result.requeue_after > 0:
+                self._queue.forget(request)
+                self._queue.add_after(request, result.requeue_after)
+            elif result is not None and result.requeue:
+                self._queue.add_rate_limited(request)
+            else:
+                self._queue.forget(request)
+            self._queue.done(request)

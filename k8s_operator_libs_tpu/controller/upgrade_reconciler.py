@@ -1,0 +1,101 @@
+"""The consumer glue: an operator reconciler around the upgrade state
+machine.
+
+This is the L5 layer the reference leaves to NVIDIA's GPU/Network
+Operators (SURVEY.md §1: "calls BuildState/ApplyState each reconcile").
+Every watched event collapses onto a **single cluster-scoped request** —
+the state machine is already a whole-fleet snapshot/apply, so per-node
+requests would only serialize redundant full passes; the workqueue's
+dedup-while-processing semantics then guarantee a change arriving
+mid-reconcile triggers exactly one follow-up pass.
+
+The reconciler requeues itself while a rollout is active (the "operator
+requeue cycle" that picks up async drain/eviction results —
+SURVEY.md §3.2) and goes quiet when the fleet is steady.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Dict, Hashable, Iterable, Optional
+
+from ..api.upgrade_spec import UpgradePolicySpec
+from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..upgrade.upgrade_state import ClusterUpgradeStateManager
+from .controller import Controller, Result
+
+logger = logging.getLogger(__name__)
+
+#: The one request every event maps to.
+UPGRADE_REQUEST = "upgrade-cycle"
+
+
+def _singleton_mapper(_obj: JsonObj) -> Iterable[Hashable]:
+    return [UPGRADE_REQUEST]
+
+
+@dataclass
+class UpgradeReconciler:
+    """Runs one BuildState/ApplyState pass per request."""
+
+    manager: ClusterUpgradeStateManager
+    namespace: str
+    driver_labels: Dict[str, str]
+    policy: UpgradePolicySpec
+    #: requeue delay while a rollout is in flight (async workers report
+    #: through node labels; this is the pickup latency)
+    active_requeue_seconds: float = 0.05
+    #: requeue delay when only failed nodes remain — their self-heal waits
+    #: on an external fix (new DS revision, manual intervention), so
+    #: polling at the active cadence would hot-loop full fleet snapshots
+    #: forever; a watch event on the fix wakes us sooner anyway
+    failed_requeue_seconds: float = 5.0
+
+    def reconcile(self, request: Hashable) -> Optional[Result]:
+        state = self.manager.build_state(self.namespace, self.driver_labels)
+        self.manager.apply_state(state, self.policy)
+        common = self.manager.common
+        if common.get_upgrades_in_progress(state) or common.get_upgrades_pending(
+            state
+        ):
+            return Result(requeue_after=self.active_requeue_seconds)
+        if common.get_upgrades_failed(state):
+            return Result(requeue_after=self.failed_requeue_seconds)
+        return None
+
+
+def new_upgrade_controller(
+    cluster: InMemoryCluster,
+    manager: ClusterUpgradeStateManager,
+    namespace: str,
+    driver_labels: Dict[str, str],
+    policy: UpgradePolicySpec,
+    *,
+    extra_kinds: Iterable[str] = (),
+    resync_seconds: float = 1.0,
+    active_requeue_seconds: float = 0.05,
+    failed_requeue_seconds: float = 5.0,
+    watch_poll_seconds: float = 0.005,
+) -> Controller:
+    """Assemble the standard operator: watches on Nodes, driver Pods,
+    DaemonSets (and NodeMaintenance when requestor mode needs it via
+    *extra_kinds*), all funneled into the singleton upgrade request."""
+    reconciler = UpgradeReconciler(
+        manager=manager,
+        namespace=namespace,
+        driver_labels=driver_labels,
+        policy=policy,
+        active_requeue_seconds=active_requeue_seconds,
+        failed_requeue_seconds=failed_requeue_seconds,
+    )
+    controller = Controller(
+        cluster,
+        reconciler,
+        name="upgrade-controller",
+        resync_seconds=resync_seconds,
+        watch_poll_seconds=watch_poll_seconds,
+    )
+    for kind in ("Node", "Pod", "DaemonSet", *extra_kinds):
+        controller.watches(kind, mapper=_singleton_mapper)
+    return controller

@@ -1,0 +1,201 @@
+"""Rate-limited work queues — the scheduling heart of the controller
+runtime.
+
+The reference library never ships an event loop: it is embedded in an
+operator built on controller-runtime, whose controller feeds a client-go
+``workqueue`` (SURVEY.md L5 — "calls BuildState/ApplyState each
+reconcile").  To make this library standalone-usable the runtime has to
+exist somewhere, so this module reimplements the client-go queue
+contract the ecosystem has converged on:
+
+* **dedup while queued** — adding an item already waiting is a no-op, so
+  a burst of watch events costs one reconcile;
+* **coalesce while processing** — adding an item currently being worked
+  marks it dirty; ``done()`` re-queues it exactly once, so a change that
+  raced the running reconcile is never lost and never duplicated;
+* **delayed add** — ``add_after`` for requeue-after semantics;
+* **per-item exponential backoff** — failures retry at
+  ``base * 2**retries`` capped at ``max_delay``; ``forget()`` resets on
+  success.
+
+Everything is condition-variable based; no busy polling.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Any, Dict, Hashable, List, Optional, Set, Tuple
+
+
+class ShutDown(Exception):
+    """Raised by :meth:`WorkQueue.get` after :meth:`WorkQueue.shutdown`."""
+
+
+class WorkQueue:
+    """Deduplicating FIFO with processing/dirty semantics (client-go's
+    Type): an item is in at most one of {queued, processing}; re-adds
+    during processing coalesce into a single re-queue at ``done()``."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._queue: List[Hashable] = []
+        self._queued: Set[Hashable] = set()
+        self._processing: Set[Hashable] = set()
+        self._dirty: Set[Hashable] = set()
+        self._shutting_down = False
+
+    def add(self, item: Hashable) -> None:
+        with self._cond:
+            if self._shutting_down:
+                return
+            if item in self._processing:
+                self._dirty.add(item)
+                return
+            if item in self._queued:
+                return
+            self._queued.add(item)
+            self._queue.append(item)
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Hashable]:
+        """Next item, blocking up to *timeout* (None = forever).  Returns
+        None on timeout; raises :class:`ShutDown` once the queue is both
+        shut down and drained."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._queue:
+                if self._shutting_down:
+                    raise ShutDown()
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            item = self._queue.pop(0)
+            self._queued.discard(item)
+            self._processing.add(item)
+            return item
+
+    def done(self, item: Hashable) -> None:
+        """Mark processing finished; a dirty item goes straight back in."""
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._dirty.discard(item)
+                if not self._shutting_down and item not in self._queued:
+                    self._queued.add(item)
+                    self._queue.append(item)
+                    self._cond.notify()
+            elif self._shutting_down and not self._processing:
+                self._cond.notify_all()
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutting_down = True
+            self._cond.notify_all()
+
+    @property
+    def shutting_down(self) -> bool:
+        with self._cond:
+            return self._shutting_down
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def pending_work(self) -> int:
+        """Items queued + items currently being processed (dirty items are
+        a subset of processing).  Subclasses add their delayed items."""
+        with self._cond:
+            return len(self._queue) + len(self._processing)
+
+
+class ExponentialBackoffRateLimiter:
+    """Per-item ``base * 2**failures`` delay, capped (client-go's
+    ItemExponentialFailureRateLimiter)."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 60.0) -> None:
+        self._base = base_delay
+        self._max = max_delay
+        self._lock = threading.Lock()
+        self._failures: Dict[Hashable, int] = {}
+
+    def when(self, item: Hashable) -> float:
+        with self._lock:
+            failures = self._failures.get(item, 0)
+            self._failures[item] = failures + 1
+        return min(self._base * (2 ** failures), self._max)
+
+    def num_requeues(self, item: Hashable) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+    def forget(self, item: Hashable) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+
+class RateLimitedQueue(WorkQueue):
+    """WorkQueue + delayed adds + per-item backoff.  One background timer
+    thread moves due items from the delay heap into the queue."""
+
+    def __init__(
+        self, rate_limiter: Optional[ExponentialBackoffRateLimiter] = None
+    ) -> None:
+        super().__init__()
+        self._limiter = rate_limiter or ExponentialBackoffRateLimiter()
+        self._delay_cond = threading.Condition()
+        self._heap: List[Tuple[float, int, Hashable]] = []
+        self._seq = itertools.count()
+        self._timer = threading.Thread(target=self._timer_loop, daemon=True)
+        self._timer.start()
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._delay_cond:
+            heapq.heappush(
+                self._heap, (time.monotonic() + delay, next(self._seq), item)
+            )
+            self._delay_cond.notify()
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        self.add_after(item, self._limiter.when(item))
+
+    def forget(self, item: Hashable) -> None:
+        self._limiter.forget(item)
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self._limiter.num_requeues(item)
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        with self._delay_cond:
+            self._delay_cond.notify_all()
+
+    def pending_work(self) -> int:
+        with self._delay_cond:
+            delayed = len(self._heap)
+        return super().pending_work() + delayed
+
+    # ------------------------------------------------------------- internals
+    def _timer_loop(self) -> None:
+        while True:
+            with self._delay_cond:
+                if self.shutting_down:
+                    return
+                if not self._heap:
+                    self._delay_cond.wait(0.5)
+                    continue
+                due, _, item = self._heap[0]
+                now = time.monotonic()
+                if due > now:
+                    self._delay_cond.wait(min(due - now, 0.5))
+                    continue
+                heapq.heappop(self._heap)
+            self.add(item)

@@ -254,15 +254,28 @@ class TestStateMachineWiring:
             + reg.gauge("upgrades_pending", "").value()
             > 0
         )
-        # ...then the operator pauses the rollout mid-flight
+        # ...then the operator pauses the rollout mid-flight.  Swap in a
+        # brand-new registry first: any gauge present afterwards can only
+        # have been published by the paused apply_state itself.
         paused = UpgradePolicySpec(auto_upgrade=False)
-        manager.apply_state(manager.build_state(NAMESPACE, DRIVER_LABELS), paused)
-        # gauges re-published from the live snapshot, not frozen: the node
-        # is still mid-upgrade so in_progress reflects reality, and the
-        # family keeps updating on every paused reconcile
-        snapshot = reg.render()
-        manager.apply_state(manager.build_state(NAMESPACE, DRIVER_LABELS), paused)
-        assert "nodes_in_state" in snapshot
+        paused_reg = MetricsRegistry()
+        metrics.set_default_registry(paused_reg)
+        try:
+            manager.apply_state(
+                manager.build_state(NAMESPACE, DRIVER_LABELS), paused
+            )
+        finally:
+            metrics.set_default_registry(reg)
+        # the paused branch re-published the whole gauge family from the
+        # live snapshot — the node is still mid-upgrade and says so
+        assert paused_reg.gauge("managed_nodes", "").value() == 1
+        text = paused_reg.render()
+        assert "nodes_in_state" in text
+        assert (
+            paused_reg.gauge("upgrades_in_progress", "").value()
+            + paused_reg.gauge("upgrades_pending", "").value()
+            > 0
+        )
 
     def test_drain_failure_counted(self, cluster, fresh_registry):
         fleet = Fleet(cluster, revision_hash="v1")
