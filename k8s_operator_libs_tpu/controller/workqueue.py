@@ -150,6 +150,9 @@ class RateLimitedQueue(WorkQueue):
         self._limiter = rate_limiter or ExponentialBackoffRateLimiter()
         self._delay_cond = threading.Condition()
         self._heap: List[Tuple[float, int, Hashable]] = []
+        # items popped from the heap but not yet add()ed — bridges the
+        # cross-lock handoff so pending_work() never under-counts
+        self._handoff = 0
         self._seq = itertools.count()
         self._timer = threading.Thread(target=self._timer_loop, daemon=True)
         self._timer.start()
@@ -180,7 +183,7 @@ class RateLimitedQueue(WorkQueue):
 
     def pending_work(self) -> int:
         with self._delay_cond:
-            delayed = len(self._heap)
+            delayed = len(self._heap) + self._handoff
         return super().pending_work() + delayed
 
     # ------------------------------------------------------------- internals
@@ -198,4 +201,9 @@ class RateLimitedQueue(WorkQueue):
                     self._delay_cond.wait(min(due - now, 0.5))
                     continue
                 heapq.heappop(self._heap)
-            self.add(item)
+                self._handoff += 1
+            try:
+                self.add(item)
+            finally:
+                with self._delay_cond:
+                    self._handoff -= 1

@@ -8,9 +8,12 @@ workload on *every* host of the slice.  This module supplies the
 domain-level accounting the slice-aware throttle uses instead
 (SURVEY.md §7 step 4, hard part #1):
 
-* a node's **domain** is its slice id (from ``SLICE_ID_LABEL_KEYS``, e.g.
-  ``tpu.google.com/slice-id`` or the GKE TPU topology labels), or a
-  singleton domain for nodes without slice labels;
+* a node's **domain** is, in precedence order: its **multislice group**
+  (from ``MULTISLICE_GROUP_LABEL_KEYS`` — several ICI slices coupled over
+  DCN into one MegaScale-style job, where draining any member slice kills
+  the whole job), else its slice id (from ``SLICE_ID_LABEL_KEYS``, e.g.
+  ``tpu.google.com/slice-id`` or the GKE TPU topology labels), else a
+  singleton domain for nodes without either label;
 * a domain is *unavailable* if **any** of its nodes is cordoned or
   not-ready (the slice can't run SPMD work at partial strength);
 * a domain is *in progress* if any of its nodes is in an active upgrade
@@ -38,20 +41,39 @@ from ..upgrade import consts
 
 #: Prefix for the singleton domain of a node with no slice label.
 _SINGLETON_PREFIX = "node:"
+#: Prefix namespacing multislice-group domains away from slice ids (a
+#: group named "a" and an unrelated slice named "a" must not merge).
+_GROUP_PREFIX = "msgroup:"
 
 
-def slice_id_of(node: JsonObj) -> Optional[str]:
-    """The node's slice identity, or None if it carries no slice label."""
+def _first_label(node: JsonObj, keys: Iterable[str]) -> Optional[str]:
+    """First truthy label value among *keys*, in precedence order."""
     labels = (node.get("metadata") or {}).get("labels") or {}
-    for key in consts.SLICE_ID_LABEL_KEYS:
+    for key in keys:
         value = labels.get(key)
         if value:
             return value
     return None
 
 
+def slice_id_of(node: JsonObj) -> Optional[str]:
+    """The node's slice identity, or None if it carries no slice label."""
+    return _first_label(node, consts.SLICE_ID_LABEL_KEYS)
+
+
+def multislice_group_of(node: JsonObj) -> Optional[str]:
+    """The node's multislice job group, or None if it is not part of a
+    DCN-coupled multislice job."""
+    return _first_label(node, consts.MULTISLICE_GROUP_LABEL_KEYS)
+
+
 def domain_of(node: JsonObj) -> str:
-    """The node's atomic unavailability domain (slice id or itself)."""
+    """The node's atomic unavailability domain: multislice group if
+    labeled (the whole DCN-coupled job is one failure domain), else slice
+    id, else the node itself."""
+    group = multislice_group_of(node)
+    if group is not None:
+        return _GROUP_PREFIX + group
     sid = slice_id_of(node)
     if sid is not None:
         return sid

@@ -152,6 +152,16 @@ SLICE_ID_LABEL_KEYS = (
     "cloud.google.com/gke-tpu-topology",
 )
 
+#: Node labels (checked in order) identifying a **multislice job group** —
+#: several ICI slices coupled over DCN into one SPMD job (MegaScale-style
+#: data parallelism across slices).  Disrupting any member slice kills the
+#: whole job, so a group label outranks the slice label as the atomic
+#: unavailability domain.
+MULTISLICE_GROUP_LABEL_KEYS = (
+    DOMAIN + "/multislice-group",
+    "cloud.google.com/gke-tpu-multislice-group",
+)
+
 #: Annotation value for "true" booleans (reference uses "true" strings).
 TRUE_STRING = "true"
 

@@ -161,17 +161,21 @@ class RequestorNodeStateManager:
 
     def new_node_maintenance(self, node_name: str) -> JsonObj:
         """Reference: NewNodeMaintenance (:176-182).  TPU-native: the node's
-        slice domain rides along in ``spec.sliceId`` so a slice-aware
-        maintenance operator can co-schedule all hosts of the slice."""
+        **atomic domain** rides along in ``spec.sliceId`` so a slice-aware
+        maintenance operator can co-schedule every host that must go down
+        together.  This is ``topology.domain_of`` — a multislice job group
+        when labeled (all DCN-coupled slices in one wave; batching per
+        individual slice would disrupt the job once per slice), else the
+        slice id."""
         from ..cluster.objects import make_node_maintenance
         from ..tpu import topology
 
         spec_extra = dict(self._default_spec)
         try:
             node = self._cluster.get("Node", node_name)
-            sid = topology.slice_id_of(node)
-            if sid is not None:
-                spec_extra["sliceId"] = sid
+            domain = topology.domain_of(node)
+            if not topology.is_singleton_domain(domain):
+                spec_extra["sliceId"] = domain
         except NotFoundError:
             pass
         return make_node_maintenance(

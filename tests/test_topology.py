@@ -6,6 +6,7 @@ from k8s_operator_libs_tpu.upgrade import consts
 
 SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
 GKE_KEY = consts.SLICE_ID_LABEL_KEYS[1]
+GROUP_KEY = consts.MULTISLICE_GROUP_LABEL_KEYS[0]
 
 
 class TestDomains:
@@ -36,6 +37,52 @@ class TestDomains:
             "s-b": 1,
             "node:solo": 1,
         }
+
+
+class TestMultisliceGroups:
+    """A DCN-coupled multislice job is one atomic domain: draining any
+    member slice kills the whole job, so the group label outranks the
+    slice label."""
+
+    def test_group_label_outranks_slice_label(self):
+        node = make_node("n1", labels={SLICE_KEY: "s-a", GROUP_KEY: "job-7"})
+        assert topology.multislice_group_of(node) == "job-7"
+        assert topology.domain_of(node) == "msgroup:job-7"
+
+    def test_two_slices_of_one_job_share_a_domain(self):
+        nodes = [
+            make_node("a1", labels={SLICE_KEY: "s-a", GROUP_KEY: "job-7"}),
+            make_node("a2", labels={SLICE_KEY: "s-a", GROUP_KEY: "job-7"}),
+            make_node("b1", labels={SLICE_KEY: "s-b", GROUP_KEY: "job-7"}),
+            make_node("c1", labels={SLICE_KEY: "s-c"}),  # independent slice
+        ]
+        groups = topology.group_by_domain(nodes)
+        assert {k: len(v) for k, v in groups.items()} == {
+            "msgroup:job-7": 3,
+            "s-c": 1,
+        }
+        assert topology.count_domains(nodes) == 2
+
+    def test_group_name_never_collides_with_slice_name(self):
+        grouped = make_node("g", labels={GROUP_KEY: "alpha"})
+        sliced = make_node("s", labels={SLICE_KEY: "alpha"})
+        assert topology.domain_of(grouped) != topology.domain_of(sliced)
+
+    def test_one_sick_host_poisons_whole_job_group(self):
+        nodes = [
+            make_node("a1", labels={SLICE_KEY: "s-a", GROUP_KEY: "job-7"},
+                      ready=False),
+            make_node("b1", labels={SLICE_KEY: "s-b", GROUP_KEY: "job-7"}),
+            make_node("c1", labels={SLICE_KEY: "s-c"}),
+        ]
+        # the sick host takes down job-7's entire domain; slice s-c is fine
+        assert topology.count_unavailable_domains(nodes) == 1
+
+    def test_gke_group_label_fallback(self):
+        node = make_node(
+            "n1", labels={consts.MULTISLICE_GROUP_LABEL_KEYS[1]: "ms-2"}
+        )
+        assert topology.multislice_group_of(node) == "ms-2"
 
 
 class TestUnavailability:

@@ -327,6 +327,27 @@ class TestSharedRequestorProtocol:
         nm = requestor.get_node_maintenance_obj("n1")
         assert nm["spec"]["sliceId"] == "slice-7"
 
+    def test_node_maintenance_carries_multislice_domain(self, cluster, fleet):
+        """A multislice-group node's CR must hint the *job-group* domain,
+        not its individual slice — an external operator batching by
+        sliceId would otherwise disrupt the DCN-coupled job once per
+        member slice."""
+        fleet.add_node(
+            "n1",
+            pod_hash="rev1",
+            labels={
+                consts.SLICE_ID_LABEL_KEYS[0]: "slice-7",
+                consts.MULTISLICE_GROUP_LABEL_KEYS[0]: "job-A",
+            },
+        )
+        fleet.publish_new_revision("rev2")
+        manager, requestor = make_requestor_manager(cluster)
+        policy = UpgradePolicySpec(auto_upgrade=True)
+        reconcile(manager, fleet, policy)
+        reconcile(manager, fleet, policy)
+        nm = requestor.get_node_maintenance_obj("n1")
+        assert nm["spec"]["sliceId"] == "msgroup:job-A"
+
     def test_stale_snapshot_of_deleted_cr_is_noop(self, cluster):
         """Regression: the owner deleted the CR between BuildState and the
         uncordon pass — the secondary's cleanup must no-op, not crash the

@@ -794,6 +794,88 @@ class TestSliceAwareThrottle:
         assert started in ({"s0-h0", "s0-h1"}, {"lonely"})
 
 
+class TestMultisliceThrottle:
+    """TPU-native: a DCN-coupled multislice job group (MegaScale-style)
+    is one atomic domain — all member slices co-schedule and count once
+    toward maxUnavailable, because draining any slice kills the job."""
+
+    SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
+    GROUP_KEY = consts.MULTISLICE_GROUP_LABEL_KEYS[0]
+
+    def _multislice_fleet(self, fleet):
+        """job-A spans slices s0+s1 (2 hosts each); s2 is independent."""
+        for s in range(2):
+            for h in range(2):
+                fleet.add_node(
+                    f"s{s}-h{h}",
+                    pod_hash="rev1",
+                    labels={self.SLICE_KEY: f"s{s}", self.GROUP_KEY: "job-A"},
+                )
+        for h in range(2):
+            fleet.add_node(
+                f"s2-h{h}", pod_hash="rev1", labels={self.SLICE_KEY: "s2"}
+            )
+        fleet.publish_new_revision("rev2")
+
+    def test_whole_job_group_coscheduled_as_one_slot(self, cluster, fleet):
+        self._multislice_fleet(fleet)
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString(1),  # one *domain* of the two
+            slice_aware=True,
+        )
+        reconcile(manager, fleet, policy, cycles=2)
+        started = {
+            n
+            for n, s in fleet.states().items()
+            if s not in ("", consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        }
+        # either all 4 hosts of job-A advanced together, or the 2 hosts of
+        # the independent slice — never a partial job group
+        assert started in ({"s0-h0", "s0-h1", "s1-h0", "s1-h1"},
+                           {"s2-h0", "s2-h1"})
+
+    def test_sick_host_in_one_slice_blocks_whole_group_budget(
+        self, cluster, fleet
+    ):
+        self._multislice_fleet(fleet)
+        # one host of s1 is down: job-A's domain is already unavailable,
+        # consuming the single maxUnavailable slot — nothing new starts
+        sick = cluster.get("Node", "s1-h0")
+        set_condition(sick, "Ready", "False")
+        cluster.update(sick)
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString(1),
+            slice_aware=True,
+        )
+        reconcile(manager, fleet, policy, cycles=2)
+        advanced = {
+            n
+            for n, s in fleet.states().items()
+            if s
+            not in ("", consts.UPGRADE_STATE_UPGRADE_REQUIRED,
+                    consts.UPGRADE_STATE_DONE)
+        }
+        assert advanced == set()
+
+    def test_multislice_full_rolling_upgrade(self, cluster, fleet):
+        self._multislice_fleet(fleet)
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString(1),
+            slice_aware=True,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        assert run_to_completion(manager, fleet, policy, max_cycles=60)
+
+
 class TestCascadeReconcile:
     """Pipelined ApplyState: one pass carries a node through every
     synchronous transition (bucket migration between phases), cutting the
