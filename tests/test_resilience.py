@@ -41,6 +41,7 @@ from k8s_operator_libs_tpu.upgrade.upgrade_state import ClusterUpgradeStateManag
 from harness import DRIVER_LABELS, NAMESPACE, Fleet
 
 SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
+GROUP_KEY = consts.MULTISLICE_GROUP_LABEL_KEYS[0]
 
 IDLE_STATES = ("", consts.UPGRADE_STATE_DONE, consts.UPGRADE_STATE_UPGRADE_REQUIRED)
 
@@ -89,13 +90,18 @@ class CrashingCluster:
 
 
 def build_random_fleet(rng: random.Random, cluster) -> Fleet:
-    """2-3 slices x 2-3 hosts plus 0-2 singletons, all out of date."""
+    """2-3 slices x 2-3 hosts plus 0-2 singletons, all out of date.
+    Half the time the first two slices are DCN-coupled into one
+    multislice job group (their nodes then form a single atomic domain)."""
     fleet = Fleet(cluster)
-    for s in range(rng.randint(2, 3)):
+    n_slices = rng.randint(2, 3)
+    multislice = rng.random() < 0.5
+    for s in range(n_slices):
+        labels = {SLICE_KEY: f"slice-{s}"}
+        if multislice and s < 2:
+            labels[GROUP_KEY] = "job-A"
         for h in range(rng.randint(2, 3)):
-            fleet.add_node(
-                f"s{s}-h{h}", pod_hash="rev1", labels={SLICE_KEY: f"slice-{s}"}
-            )
+            fleet.add_node(f"s{s}-h{h}", pod_hash="rev1", labels=dict(labels))
     for i in range(rng.randint(0, 2)):
         fleet.add_node(f"solo{i}", pod_hash="rev1")
     fleet.publish_new_revision("rev2")
@@ -264,6 +270,79 @@ class TestThrottleInvariantsProperty:
             manager, fleet, policy, cluster, rng=rng
         ), f"seed {seed} did not converge: {fleet.states()}"
         assert_all_pods_at(cluster, "rev2")
+
+
+class TestControllerCrashResume:
+    """Kill the whole event-driven operator (controller + manager + its
+    informer cache) mid-rollout and boot a replacement: the label-resident
+    state must let the new operator pick up exactly where the old one
+    died — the end-to-end version of the crash-resume property, through
+    the controller runtime instead of a manual reconcile loop."""
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_operator_restart_mid_rollout_converges(self, seed):
+        import time as _time
+
+        from k8s_operator_libs_tpu.controller import new_upgrade_controller
+
+        rng = random.Random(4000 + seed)
+        cluster = InMemoryCluster()
+        fleet = build_random_fleet(rng, cluster)
+        policy = random_policy(rng)
+
+        stop_ds = threading.Event()
+
+        def ds_loop():
+            while not stop_ds.is_set():
+                fleet.reconcile_daemonset()
+                _time.sleep(0.02)
+
+        ds_thread = threading.Thread(target=ds_loop, daemon=True)
+        ds_thread.start()
+
+        def boot():
+            manager = make_manager(cluster)
+            return manager, new_upgrade_controller(
+                cluster, manager, NAMESPACE, DRIVER_LABELS, policy,
+                resync_seconds=0.1, active_requeue_seconds=0.02,
+            )
+
+        manager, ctrl = boot()
+        ctrl.start()
+        try:
+            # let the first operator make some progress, then kill it at a
+            # random point.  Python threads can't be killed, so the dead
+            # operator's async drain/eviction workers are drained to
+            # completion instead — the settled-point approximation of a
+            # whole-process death (every other invariant check in this
+            # suite is likewise post-wait_idle).
+            _time.sleep(rng.uniform(0.05, 0.4))
+            ctrl.stop(timeout=5.0)
+            manager.drain_manager.wait_idle(10.0)
+            manager.pod_manager.wait_idle(10.0)
+            check_invariants(cluster, policy)
+
+            manager, ctrl = boot()  # the replacement process
+            ctrl.start()
+            deadline = _time.monotonic() + 30.0
+            while _time.monotonic() < deadline:
+                states = fleet.states()
+                if states and set(states.values()) == {
+                    consts.UPGRADE_STATE_DONE
+                }:
+                    break
+                _time.sleep(0.05)
+            else:
+                pytest.fail(
+                    f"seed {seed} did not converge after restart: "
+                    f"{fleet.states()}"
+                )
+            check_invariants(cluster, policy)
+            assert_all_pods_at(cluster, "rev2")
+        finally:
+            ctrl.stop()
+            stop_ds.set()
+            ds_thread.join(2.0)
 
 
 class TestSplitBrain:
