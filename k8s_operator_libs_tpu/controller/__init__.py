@@ -7,6 +7,7 @@ from .controller import (
     Request,
     Result,
 )
+from .leader_election import LeaderElector
 from .upgrade_reconciler import (
     UPGRADE_REQUEST,
     UpgradeReconciler,
@@ -21,6 +22,7 @@ from .workqueue import (
 
 __all__ = [
     "Controller",
+    "LeaderElector",
     "Reconciler",
     "Request",
     "Result",

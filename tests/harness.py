@@ -13,7 +13,10 @@ pods the same way).
 from __future__ import annotations
 
 import itertools
-from typing import Dict, List, Optional
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator, List, Optional
 
 from k8s_operator_libs_tpu.cluster.inmem import InMemoryCluster, JsonObj
 from k8s_operator_libs_tpu.cluster.objects import (
@@ -202,3 +205,38 @@ class FakeMaintenanceOperator:
             handled += 1
         return handled
 
+
+
+@contextmanager
+def daemonset_loop(fleet: Fleet, interval: float = 0.02) -> Iterator[None]:
+    """Run the fake DaemonSet controller on a background thread for the
+    duration of the block — the substrate event-driven operator tests
+    need (a real cluster's DS controller recreates deleted driver pods
+    continuously, not once per hand-driven reconcile)."""
+    stop = threading.Event()
+
+    def loop() -> None:
+        while not stop.is_set():
+            fleet.reconcile_daemonset()
+            time.sleep(interval)
+
+    thread = threading.Thread(target=loop, daemon=True)
+    thread.start()
+    try:
+        yield
+    finally:
+        stop.set()
+        thread.join(2.0)
+
+
+def wait_for_converged(fleet: Fleet, timeout: float = 30.0) -> bool:
+    """Poll until every managed node reports upgrade-done."""
+    from k8s_operator_libs_tpu.upgrade import consts
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        states = fleet.states()
+        if states and set(states.values()) == {consts.UPGRADE_STATE_DONE}:
+            return True
+        time.sleep(0.05)
+    return False

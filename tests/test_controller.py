@@ -21,7 +21,13 @@ from k8s_operator_libs_tpu.controller import (
 )
 from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager, consts
 
-from harness import DRIVER_LABELS, NAMESPACE, Fleet
+from harness import (
+    DRIVER_LABELS,
+    NAMESPACE,
+    Fleet,
+    daemonset_loop,
+    wait_for_converged,
+)
 
 
 class TestWorkQueue:
@@ -404,31 +410,14 @@ class TestUpgradeOperator:
             resync_seconds=0.1, active_requeue_seconds=0.02,
         )
         # the simulated DaemonSet controller restarts deleted driver pods
-        stop_ds = threading.Event()
-
-        def ds_controller():
-            while not stop_ds.is_set():
-                fleet.reconcile_daemonset()
-                time.sleep(0.02)
-
-        ds_thread = threading.Thread(target=ds_controller, daemon=True)
-        ds_thread.start()
-        ctrl.start()
-        try:
-            deadline = time.monotonic() + 30.0
-            while time.monotonic() < deadline:
-                states = fleet.states()
-                if states and all(
-                    s == consts.UPGRADE_STATE_DONE for s in states.values()
-                ):
-                    break
-                time.sleep(0.05)
-            else:
-                pytest.fail(f"rollout did not converge: {fleet.states()}")
-        finally:
-            ctrl.stop()
-            stop_ds.set()
-            ds_thread.join(2.0)
+        with daemonset_loop(fleet):
+            ctrl.start()
+            try:
+                assert wait_for_converged(fleet), (
+                    f"rollout did not converge: {fleet.states()}"
+                )
+            finally:
+                ctrl.stop()
 
     def test_steady_fleet_goes_quiet(self, cluster):
         """No rollout pending — the reconciler must not self-requeue

@@ -38,7 +38,13 @@ from k8s_operator_libs_tpu.tpu import topology
 from k8s_operator_libs_tpu.upgrade import consts, util
 from k8s_operator_libs_tpu.upgrade.upgrade_state import ClusterUpgradeStateManager
 
-from harness import DRIVER_LABELS, NAMESPACE, Fleet
+from harness import (
+    DRIVER_LABELS,
+    NAMESPACE,
+    Fleet,
+    daemonset_loop,
+    wait_for_converged,
+)
 
 SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
 GROUP_KEY = consts.MULTISLICE_GROUP_LABEL_KEYS[0]
@@ -290,16 +296,6 @@ class TestControllerCrashResume:
         fleet = build_random_fleet(rng, cluster)
         policy = random_policy(rng)
 
-        stop_ds = threading.Event()
-
-        def ds_loop():
-            while not stop_ds.is_set():
-                fleet.reconcile_daemonset()
-                _time.sleep(0.02)
-
-        ds_thread = threading.Thread(target=ds_loop, daemon=True)
-        ds_thread.start()
-
         def boot():
             manager = make_manager(cluster)
             return manager, new_upgrade_controller(
@@ -307,42 +303,32 @@ class TestControllerCrashResume:
                 resync_seconds=0.1, active_requeue_seconds=0.02,
             )
 
-        manager, ctrl = boot()
-        ctrl.start()
-        try:
-            # let the first operator make some progress, then kill it at a
-            # random point.  Python threads can't be killed, so the dead
-            # operator's async drain/eviction workers are drained to
-            # completion instead — the settled-point approximation of a
-            # whole-process death (every other invariant check in this
-            # suite is likewise post-wait_idle).
-            _time.sleep(rng.uniform(0.05, 0.4))
-            ctrl.stop(timeout=5.0)
-            manager.drain_manager.wait_idle(10.0)
-            manager.pod_manager.wait_idle(10.0)
-            check_invariants(cluster, policy)
-
-            manager, ctrl = boot()  # the replacement process
+        with daemonset_loop(fleet):
+            manager, ctrl = boot()
             ctrl.start()
-            deadline = _time.monotonic() + 30.0
-            while _time.monotonic() < deadline:
-                states = fleet.states()
-                if states and set(states.values()) == {
-                    consts.UPGRADE_STATE_DONE
-                }:
-                    break
-                _time.sleep(0.05)
-            else:
-                pytest.fail(
+            try:
+                # let the first operator make some progress, then kill it
+                # at a random point.  Python threads can't be killed, so
+                # the dead operator's async drain/eviction workers are
+                # drained to completion instead — the settled-point
+                # approximation of a whole-process death (every other
+                # invariant check in this suite is likewise post-wait_idle).
+                _time.sleep(rng.uniform(0.05, 0.4))
+                ctrl.stop(timeout=5.0)
+                manager.drain_manager.wait_idle(10.0)
+                manager.pod_manager.wait_idle(10.0)
+                check_invariants(cluster, policy)
+
+                manager, ctrl = boot()  # the replacement process
+                ctrl.start()
+                assert wait_for_converged(fleet), (
                     f"seed {seed} did not converge after restart: "
                     f"{fleet.states()}"
                 )
-            check_invariants(cluster, policy)
-            assert_all_pods_at(cluster, "rev2")
-        finally:
-            ctrl.stop()
-            stop_ds.set()
-            ds_thread.join(2.0)
+                check_invariants(cluster, policy)
+                assert_all_pods_at(cluster, "rev2")
+            finally:
+                ctrl.stop()
 
 
 class TestSplitBrain:
