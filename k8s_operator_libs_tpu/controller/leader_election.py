@@ -110,7 +110,11 @@ class LeaderElector:
             self._thread.join(timeout)
         if self.is_leader:
             self._demote()
-            self.release()
+        # Release unconditionally (it no-ops unless we hold the lease on
+        # the server): a deadline-demoted leader has is_leader False but
+        # may still be the nominal holder after a healed partition — the
+        # successor should not have to wait out the TTL.
+        self.release()
 
     def release(self) -> None:
         """Zero the holder if we own the lease (clean handoff)."""
@@ -168,14 +172,32 @@ class LeaderElector:
             self._is_leader = True
         logger.info("%s: became leader of %s", self.identity, self._lock_name)
         if self._on_started is not None:
-            self._on_started()
+            try:
+                self._on_started()
+            except Exception:  # noqa: BLE001 — thread boundary
+                # Leader work failed to start: an exception escaping here
+                # would kill the campaign thread with is_leader stuck True
+                # (a silent split-brain once a standby takes over).  Step
+                # down and hand off instead.
+                logger.exception(
+                    "%s: on_started_leading raised; stepping down", self.identity
+                )
+                self._demote()
+                self.release()
 
     def _demote(self) -> None:
         with self._lock:
             was = self._is_leader
             self._is_leader = False
         if was and self._on_stopped is not None:
-            self._on_stopped()
+            try:
+                self._on_stopped()
+            except Exception:  # noqa: BLE001 — thread boundary
+                # Already demoted flag-wise; a raising stop callback must
+                # not kill the campaign thread (it may re-acquire later).
+                logger.exception(
+                    "%s: on_stopped_leading raised", self.identity
+                )
 
     def _try_acquire_or_renew(self) -> bool:
         now = time.time()

@@ -201,6 +201,65 @@ class CommonUpgradeManager:
                 return True
         return False
 
+    # ----------------------------------------- slice-coherent safe-load barrier
+    def get_slice_load_blocked_domains(self, state: ClusterUpgradeState):
+        """Domains holding the slice-coherent safe-load barrier closed:
+        those with at least one node whose driver pod is not yet at the
+        target DaemonSet revision (or is orphaned).  Returns ``None`` when
+        slice-coherent mode is off — callers treat that as "no barrier".
+
+        The reference's safe-load release is per-node
+        (safe_driver_load_manager.go:57-71); this is the TPU-native
+        all-hosts-at-target-revision strengthening of it (see module
+        docstring of :mod:`.safe_driver_load_manager`).
+
+        Peers that will never sync under the current flow do NOT hold the
+        barrier — waiting on them would wedge their slice forever while
+        pinning a throttle slot: skip-labeled nodes (admin explicitly
+        exempted them; coherence is unattainable by choice) and nodes in
+        upgrade-failed (the slice is already broken; holding its healthy
+        hosts hostage cannot fix it — they self-heal through the failed
+        processor once repaired out-of-band)."""
+        # getattr: consumer-supplied doubles (tests/mocks.py pattern) may
+        # not model the flag; absent means off.
+        if not getattr(self.safe_driver_load_manager, "slice_coherent", False):
+            return None
+        # One fleet scan per snapshot, not per processor: pod revisions in
+        # the snapshot cannot change mid-pass, so the set is stable for the
+        # lifetime of this ClusterUpgradeState.
+        cached = getattr(state, "_slice_load_blocked_domains", None)
+        if cached is not None:
+            return cached
+        blocked = set()
+        for bucket, node_states in state.node_states.items():
+            if bucket not in consts.ALL_STATES:
+                continue
+            if bucket == consts.UPGRADE_STATE_FAILED:
+                continue
+            for ns in node_states:
+                if self.skip_node_upgrade(ns.node):
+                    continue
+                synced, orphaned = self.pod_in_sync_with_ds(ns)
+                if not synced or orphaned:
+                    blocked.add(topology.domain_of(ns.node))
+        state._slice_load_blocked_domains = blocked
+        return blocked
+
+    def held_at_slice_load_barrier(
+        self, node_state: NodeUpgradeState, blocked_domains
+    ) -> bool:
+        """True when *node* must stay blocked at its safe-load annotation
+        because a slice peer has not reached the target revision.  Nodes
+        not waiting for safe load are never held (their runtime is already
+        up — there is nothing to gate); singleton domains never block (the
+        node's own pod is synced by the time callers ask)."""
+        if not blocked_domains:
+            return False
+        node = node_state.node
+        if not self.safe_driver_load_manager.is_waiting_for_safe_driver_load(node):
+            return False
+        return topology.domain_of(node) in blocked_domains
+
     # ------------------------------------------------------------- processors
     def process_done_or_unknown_nodes(
         self, state: ClusterUpgradeState, state_name: str
@@ -316,7 +375,11 @@ class CommonUpgradeManager:
     def process_pod_restart_nodes(self, state: ClusterUpgradeState) -> None:
         """Reference: ProcessPodRestartNodes (:457-524)."""
         pods_to_restart: List[JsonObj] = []
-        for node_state in state.nodes_in(consts.UPGRADE_STATE_POD_RESTART_REQUIRED):
+        restart_bucket = state.nodes_in(consts.UPGRADE_STATE_POD_RESTART_REQUIRED)
+        blocked_domains = (
+            self.get_slice_load_blocked_domains(state) if restart_bucket else None
+        )
+        for node_state in restart_bucket:
             node = node_state.node
             synced, orphaned = self.pod_in_sync_with_ds(node_state)
             if not synced or orphaned:
@@ -325,6 +388,12 @@ class CommonUpgradeManager:
                     "deletionTimestamp"
                 ):
                     pods_to_restart.append(node_state.driver_pod)
+                continue
+            # Slice-coherent mode: hold this host at the barrier while a
+            # slice peer is still on the old revision — deliberately held,
+            # so skip the failure check too (a held init container is not
+            # a failing driver).
+            if self.held_at_slice_load_barrier(node_state, blocked_domains):
                 continue
             # Pod is at the right revision: release a blocked driver init
             # container before checking readiness (:476-481).
@@ -372,8 +441,27 @@ class CommonUpgradeManager:
 
     def process_validation_required_nodes(self, state: ClusterUpgradeState) -> None:
         """Reference: ProcessValidationRequiredNodes (:573-604)."""
-        for node_state in state.nodes_in(consts.UPGRADE_STATE_VALIDATION_REQUIRED):
+        node_states = state.nodes_in(consts.UPGRADE_STATE_VALIDATION_REQUIRED)
+        blocked_domains = (
+            self.get_slice_load_blocked_domains(state) if node_states else None
+        )
+        for node_state in node_states:
             node = node_state.node
+            # Slice-coherent hold, as in the restart phase — skipped before
+            # validate() so the validation timeout clock does not run while
+            # the node is deliberately parked at the barrier.  Guarded on
+            # the node's OWN pod being synced (mirroring the restart
+            # phase's ordering): an unsynced own pod would put the node's
+            # own domain in the blocked set and it would hold itself
+            # forever — it must fall through to validate()/unblock and
+            # recover through the normal lifecycle instead.
+            own_synced, own_orphaned = self.pod_in_sync_with_ds(node_state)
+            if (
+                own_synced
+                and not own_orphaned
+                and self.held_at_slice_load_barrier(node_state, blocked_domains)
+            ):
+                continue
             # The driver may have restarted after entering validation; make
             # sure it is not blocked on safe load (:576-583).
             self.safe_driver_load_manager.unblock_loading(node)

@@ -132,6 +132,21 @@ class ClusterUpgradeStateManager:
         self._use_maintenance_operator = enabled
         return self
 
+    def with_slice_coherent_safe_load(
+        self, enabled: bool = True
+    ) -> "ClusterUpgradeStateManager":
+        """TPU-native: release a slice's safe-load barriers only once every
+        host of the slice has its driver pod at the target revision, so the
+        runtime never initializes the ICI fabric against old-revision
+        peers (see :mod:`.safe_driver_load_manager`).  REQUIRES a
+        ``slice_aware`` policy (enforced in :meth:`apply_state`): only
+        domain co-scheduling guarantees a barrier-held host's peers are
+        admitted in the same wave — under node-granular throttling the
+        held host would pin the very slot its peer needs, wedging the
+        rollout."""
+        self._safe_load_manager.slice_coherent = enabled
+        return self
+
     # ------------------------------------------------------------ accessors
     @property
     def common(self) -> CommonUpgradeManager:
@@ -266,6 +281,30 @@ class ClusterUpgradeStateManager:
             self._publish_gauges(common, state)
             logger.info("auto upgrade is disabled, skipping")
             return
+        if getattr(self._safe_load_manager, "slice_coherent", False):
+            # Not a preference: the coherence barrier is only deadlock-free
+            # when this library's own scheduler admits all hosts of a
+            # domain in the same wave.  Without slice_aware, a barrier-held
+            # host pins the throttle slot (and maxUnavailable budget) its
+            # unsynced slice peer needs to be admitted; in requestor mode
+            # admission is delegated to the external maintenance operator,
+            # which grants maintenance node-by-node under its own budget —
+            # the same wedge, outside our control.  Fail fast on both.
+            if not policy.slice_aware:
+                raise UpgradeStateError(
+                    "slice-coherent safe-load requires a slice_aware "
+                    "policy: a barrier-held host would otherwise pin the "
+                    "throttle slot its slice peer needs, deadlocking the "
+                    "rollout"
+                )
+            if self._use_maintenance_operator:
+                raise UpgradeStateError(
+                    "slice-coherent safe-load is not supported in requestor "
+                    "mode: admission is delegated to the external "
+                    "maintenance operator, whose node-by-node budget can "
+                    "strand a barrier-held host waiting on a peer that is "
+                    "never granted maintenance"
+                )
         started = time.monotonic()
         try:
             self._apply_state(common, state, policy)

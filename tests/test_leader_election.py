@@ -310,3 +310,85 @@ class TestLeaderGatedOperator:
             finally:
                 elector_b.stop()
                 ctrl_b.stop()
+
+
+class TestCallbackSafety:
+    def test_raising_on_started_steps_down_instead_of_wedging(self):
+        """Regression: an exception from on_started_leading used to kill
+        the campaign thread outside its try/except, leaving is_leader
+        permanently True with renewals stopped — a silent split-brain once
+        a standby took over.  The elector must step down and release."""
+        cluster = InMemoryCluster()
+
+        def boom():
+            raise RuntimeError("controller already started")
+
+        a = LeaderElector(
+            cluster, "upgrade-operator", "a", on_started_leading=boom, **FAST
+        )
+        a.start()
+        try:
+            # promote fires, callback raises → elector demotes + releases;
+            # but the campaign thread stays alive and will re-promote (and
+            # re-fail) each retry — so assert on the server-side lease and
+            # that is_leader is never stuck True while the holder is gone
+            assert wait_for(lambda: a.leader_identity() in (None, "a"))
+            time.sleep(0.2)  # several promote/fail cycles
+            assert a._thread.is_alive()  # campaign thread survived
+            # a standby can take over because the lease keeps being freed
+            b, b_events = make_elector(cluster, "b")
+            b.start()
+            try:
+                assert wait_for(lambda: b.is_leader, timeout=5.0)
+            finally:
+                b.stop()
+        finally:
+            a.stop()
+
+    def test_raising_on_stopped_does_not_kill_campaign(self):
+        cluster = InMemoryCluster()
+
+        def boom():
+            raise RuntimeError("teardown failed")
+
+        a = LeaderElector(
+            cluster, "upgrade-operator", "a", on_stopped_leading=boom, **FAST
+        )
+        a.start()
+        assert wait_for(lambda: a.is_leader)
+        # partition → deadline demotion runs the raising callback
+        original_update = cluster.update
+        cluster.update = lambda obj: (_ for _ in ()).throw(
+            RuntimeError("partition")
+        )
+        try:
+            assert wait_for(lambda: not a.is_leader, timeout=5.0)
+            assert a._thread.is_alive()  # thread survived the raise
+        finally:
+            cluster.update = original_update
+        # store heals → the same elector re-acquires
+        assert wait_for(lambda: a.is_leader, timeout=5.0)
+        a.stop()
+
+    def test_stop_after_deadline_demotion_still_releases_lease(self):
+        """Regression: stop() used to skip release() when is_leader was
+        already False — but a deadline-demoted leader can still be the
+        nominal holder on the server after a healed partition, forcing the
+        successor to wait out the TTL."""
+        cluster = InMemoryCluster()
+        # long lease, short deadline: demotion happens well before expiry
+        a, _ = make_elector(
+            cluster, "a",
+            lease_duration=30.0, renew_deadline=0.3, retry_period=0.05,
+        )
+        a.start()
+        assert wait_for(lambda: a.is_leader)
+        original_update = cluster.update
+        cluster.update = lambda obj: (_ for _ in ()).throw(
+            RuntimeError("partition")
+        )
+        assert wait_for(lambda: not a.is_leader, timeout=5.0)
+        cluster.update = original_update  # partition heals
+        a.stop()  # demoted already — must STILL release the lease
+        lease = cluster.get("Lease", "upgrade-operator", "kube-system")
+        assert lease["spec"]["holderIdentity"] == ""

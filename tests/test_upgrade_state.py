@@ -968,3 +968,312 @@ class TestCascadeReconcile:
         # one more pass: validation → uncordon → done cascades through
         reconcile(manager, fleet, policy)
         assert fleet.node_state("n1") == consts.UPGRADE_STATE_DONE
+
+
+class TestSliceCoherentSafeLoad:
+    """TPU-native slice-coherent safe-load: the state machine releases a
+    slice's safe-load barriers only once every host of the slice has its
+    driver pod at the target revision — no host initializes the runtime
+    (and the ICI fabric) against old-revision peers.  The reference's
+    per-node release (safe_driver_load_manager.go:57-71) is the contrast
+    case below."""
+
+    SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
+
+    def _slice_pair_mid_restart(self, cluster, fleet):
+        """A 2-host slice mid-rollout: h0's pod is already recreated at the
+        new revision and its init container is blocked on safe load; h1's
+        pod is still at the old revision.  Both sit in
+        pod-restart-required."""
+        safe_key = util.get_wait_for_safe_load_annotation_key()
+        fleet.add_node(
+            "s0-h0",
+            pod_hash="rev2",
+            pod_ready=False,
+            labels={self.SLICE_KEY: "s0"},
+            annotations={safe_key: "pod-h0"},
+        )
+        fleet.add_node(
+            "s0-h1", pod_hash="rev1", labels={self.SLICE_KEY: "s0"}
+        )
+        fleet.publish_new_revision("rev2")
+        state_key = util.get_upgrade_state_label_key()
+        for name in ("s0-h0", "s0-h1"):
+            cluster.patch(
+                "Node",
+                name,
+                {
+                    "metadata": {
+                        "labels": {
+                            state_key: consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+                        }
+                    }
+                },
+            )
+        return safe_key
+
+    def test_host_held_until_peer_reaches_target_revision(
+        self, cluster, fleet
+    ):
+        safe_key = self._slice_pair_mid_restart(cluster, fleet)
+        manager = make_manager(cluster).with_slice_coherent_safe_load()
+        policy = UpgradePolicySpec(auto_upgrade=True, slice_aware=True)
+        reconcile(manager, fleet, policy)
+        # h0 is parked at the barrier: annotation retained, state unchanged
+        assert (
+            get_annotation(cluster.get("Node", "s0-h0"), safe_key) == "pod-h0"
+        )
+        assert (
+            fleet.node_state("s0-h0")
+            == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+        )
+        # h1's old pod was restarted and recreated at rev2 by the fleet's
+        # DS controller; the next pass opens the barrier for the slice
+        reconcile(manager, fleet, policy)
+        assert not get_annotation(cluster.get("Node", "s0-h0"), safe_key)
+
+    def test_reference_mode_releases_per_node(self, cluster, fleet):
+        """Contrast: without slice coherence the barrier opens per host,
+        torn slice and all (reference behavior)."""
+        safe_key = self._slice_pair_mid_restart(cluster, fleet)
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(auto_upgrade=True)
+        reconcile(manager, fleet, policy)
+        assert not get_annotation(cluster.get("Node", "s0-h0"), safe_key)
+
+    def test_singleton_domain_never_held(self, cluster, fleet):
+        """A node with no slice label is its own domain: other nodes'
+        revisions are irrelevant to its barrier."""
+        safe_key = util.get_wait_for_safe_load_annotation_key()
+        fleet.add_node(
+            "lonely",
+            pod_hash="rev2",
+            pod_ready=False,
+            annotations={safe_key: "pod-l"},
+        )
+        fleet.add_node("other", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        cluster.patch(
+            "Node",
+            "lonely",
+            {
+                "metadata": {
+                    "labels": {
+                        util.get_upgrade_state_label_key(): (
+                            consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+                        )
+                    }
+                }
+            },
+        )
+        manager = make_manager(cluster).with_slice_coherent_safe_load()
+        reconcile(
+            manager,
+            fleet,
+            UpgradePolicySpec(auto_upgrade=True, slice_aware=True),
+        )
+        assert not get_annotation(cluster.get("Node", "lonely"), safe_key)
+
+    def test_coherent_mode_rejects_node_granular_policy(self, cluster, fleet):
+        """Regression: slice-coherent + node-granular throttle is a
+        guaranteed livelock (a barrier-held host pins the slot its peer
+        needs) — apply_state must fail fast instead of wedging."""
+        fleet.add_node("n1")
+        manager = make_manager(cluster).with_slice_coherent_safe_load()
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        with pytest.raises(UpgradeStateError, match="slice_aware"):
+            manager.apply_state(
+                state, UpgradePolicySpec(auto_upgrade=True, slice_aware=False)
+            )
+
+    def test_validation_clock_does_not_run_while_held(self, cluster, fleet):
+        """A host parked at the barrier in validation-required must not
+        start (or run down) the 600 s validation timeout clock."""
+        safe_key = util.get_wait_for_safe_load_annotation_key()
+        fleet.add_node(
+            "s0-h0",
+            pod_hash="rev2",
+            labels={self.SLICE_KEY: "s0"},
+            annotations={safe_key: "pod-h0"},
+        )
+        fleet.add_node(
+            "s0-h1", pod_hash="rev1", labels={self.SLICE_KEY: "s0"}
+        )
+        fleet.publish_new_revision("rev2")
+        cluster.patch(
+            "Node",
+            "s0-h0",
+            {
+                "metadata": {
+                    "labels": {
+                        util.get_upgrade_state_label_key(): (
+                            consts.UPGRADE_STATE_VALIDATION_REQUIRED
+                        )
+                    }
+                }
+            },
+        )
+        manager = make_manager(cluster).with_slice_coherent_safe_load()
+        manager.with_validation_enabled("app=validator")
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        manager.apply_state(
+            state, UpgradePolicySpec(auto_upgrade=True, slice_aware=True)
+        )
+        node = cluster.get("Node", "s0-h0")
+        assert get_annotation(node, safe_key) == "pod-h0"  # still held
+        assert not get_annotation(
+            node, util.get_validation_start_time_annotation_key()
+        )
+
+    def test_slice_coherent_full_rolling_upgrade_converges(
+        self, cluster, fleet
+    ):
+        """End to end: slice-aware co-scheduling + coherent safe-load still
+        drives a 2-slice fleet to upgrade-done."""
+        for s in range(2):
+            for h in range(2):
+                fleet.add_node(
+                    f"s{s}-h{h}",
+                    pod_hash="rev1",
+                    labels={self.SLICE_KEY: f"s{s}"},
+                )
+        fleet.publish_new_revision("rev2")
+        manager = make_manager(cluster).with_slice_coherent_safe_load()
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString("50%"),
+            slice_aware=True,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        assert run_to_completion(manager, fleet, policy, max_cycles=60)
+
+    def test_requestor_mode_rejected(self, cluster, fleet):
+        """Regression: requestor mode delegates admission to the external
+        maintenance operator, whose node-by-node budget can strand a
+        barrier-held host — the combination must fail fast."""
+        fleet.add_node("n1")
+        from k8s_operator_libs_tpu.upgrade.upgrade_requestor import (
+            RequestorNodeStateManager,
+            RequestorOptions,
+        )
+
+        manager = make_manager(cluster).with_slice_coherent_safe_load()
+        requestor = RequestorNodeStateManager(
+            manager.common, RequestorOptions(use_maintenance_operator=True)
+        )
+        manager.with_requestor(requestor)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        with pytest.raises(UpgradeStateError, match="requestor"):
+            manager.apply_state(
+                state, UpgradePolicySpec(auto_upgrade=True, slice_aware=True)
+            )
+
+    def test_skip_labeled_peer_does_not_wedge_slice(self, cluster, fleet):
+        """Regression: a skip-labeled peer never syncs by design; it must
+        not hold its slice's barrier closed forever."""
+        safe_key = util.get_wait_for_safe_load_annotation_key()
+        fleet.add_node(
+            "s0-h0",
+            pod_hash="rev2",
+            pod_ready=False,
+            labels={self.SLICE_KEY: "s0"},
+            annotations={safe_key: "pod-h0"},
+        )
+        fleet.add_node(
+            "s0-h1",
+            pod_hash="rev1",
+            labels={
+                self.SLICE_KEY: "s0",
+                util.get_upgrade_skip_node_label_key(): consts.TRUE_STRING,
+            },
+        )
+        fleet.publish_new_revision("rev2")
+        cluster.patch(
+            "Node",
+            "s0-h0",
+            {
+                "metadata": {
+                    "labels": {
+                        util.get_upgrade_state_label_key(): (
+                            consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+                        )
+                    }
+                }
+            },
+        )
+        manager = make_manager(cluster).with_slice_coherent_safe_load()
+        policy = UpgradePolicySpec(auto_upgrade=True, slice_aware=True)
+        reconcile(manager, fleet, policy)
+        # h0 released despite h1 being unsynced: h1 is exempted by choice
+        assert not get_annotation(cluster.get("Node", "s0-h0"), safe_key)
+
+    def test_failed_peer_does_not_wedge_slice(self, cluster, fleet):
+        """Regression: a peer parked in upgrade-failed must not hold its
+        slice's healthy hosts at the barrier (the slice is already broken;
+        the failed node recovers out-of-band)."""
+        safe_key = util.get_wait_for_safe_load_annotation_key()
+        fleet.add_node(
+            "s0-h0",
+            pod_hash="rev2",
+            pod_ready=False,
+            labels={self.SLICE_KEY: "s0"},
+            annotations={safe_key: "pod-h0"},
+        )
+        fleet.add_node(
+            "s0-h1", pod_hash="rev1", labels={self.SLICE_KEY: "s0"}
+        )
+        fleet.publish_new_revision("rev2")
+        state_key = util.get_upgrade_state_label_key()
+        cluster.patch(
+            "Node",
+            "s0-h0",
+            {"metadata": {"labels": {
+                state_key: consts.UPGRADE_STATE_POD_RESTART_REQUIRED}}},
+        )
+        cluster.patch(
+            "Node",
+            "s0-h1",
+            {"metadata": {"labels": {state_key: consts.UPGRADE_STATE_FAILED}}},
+        )
+        manager = make_manager(cluster).with_slice_coherent_safe_load()
+        policy = UpgradePolicySpec(auto_upgrade=True, slice_aware=True)
+        reconcile(manager, fleet, policy)
+        assert not get_annotation(cluster.get("Node", "s0-h0"), safe_key)
+
+    def test_unsynced_own_pod_in_validation_does_not_self_hold(
+        self, cluster, fleet
+    ):
+        """Regression: a validation-required node whose own pod went
+        unsynced (revision bumped mid-validation) used to land its own
+        domain in the blocked set and hold itself forever."""
+        safe_key = util.get_wait_for_safe_load_annotation_key()
+        fleet.add_node(
+            "s0-h0",
+            pod_hash="rev2",
+            labels={self.SLICE_KEY: "s0"},
+            annotations={safe_key: "pod-h0"},
+        )
+        fleet.publish_new_revision("rev3")  # bumped again mid-validation
+        cluster.patch(
+            "Node",
+            "s0-h0",
+            {
+                "metadata": {
+                    "labels": {
+                        util.get_upgrade_state_label_key(): (
+                            consts.UPGRADE_STATE_VALIDATION_REQUIRED
+                        )
+                    }
+                }
+            },
+        )
+        manager = make_manager(cluster).with_slice_coherent_safe_load()
+        manager.with_validation_enabled("app=validator")
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        manager.apply_state(
+            state, UpgradePolicySpec(auto_upgrade=True, slice_aware=True)
+        )
+        # not self-held: the unblock ran (annotation gone) so the node can
+        # recover through the normal lifecycle
+        assert not get_annotation(cluster.get("Node", "s0-h0"), safe_key)
