@@ -183,7 +183,17 @@ class LeaderElector:
                     "%s: on_started_leading raised; stepping down", self.identity
                 )
                 self._demote()
-                self.release()
+                try:
+                    self.release()
+                except Exception as err:  # noqa: BLE001 — thread boundary
+                    # release() only swallows Conflict/NotFound; a store
+                    # outage here must not kill the campaign thread (the
+                    # lease then simply expires on its own).
+                    logger.warning(
+                        "%s: release after failed promotion errored: %s",
+                        self.identity,
+                        err,
+                    )
 
     def _demote(self) -> None:
         with self._lock:

@@ -251,12 +251,17 @@ class CommonUpgradeManager:
         """True when *node* must stay blocked at its safe-load annotation
         because a slice peer has not reached the target revision.  Nodes
         not waiting for safe load are never held (their runtime is already
-        up — there is nothing to gate); singleton domains never block (the
-        node's own pod is synced by the time callers ask)."""
+        up — there is nothing to gate).  Nodes whose OWN pod is unsynced
+        are never held either: they put their own domain in the blocked
+        set and would hold themselves forever — they must fall through to
+        the normal lifecycle (restart/validate) and recover."""
         if not blocked_domains:
             return False
         node = node_state.node
         if not self.safe_driver_load_manager.is_waiting_for_safe_driver_load(node):
+            return False
+        synced, orphaned = self.pod_in_sync_with_ds(node_state)
+        if not synced or orphaned:
             return False
         return topology.domain_of(node) in blocked_domains
 
@@ -449,18 +454,8 @@ class CommonUpgradeManager:
             node = node_state.node
             # Slice-coherent hold, as in the restart phase — skipped before
             # validate() so the validation timeout clock does not run while
-            # the node is deliberately parked at the barrier.  Guarded on
-            # the node's OWN pod being synced (mirroring the restart
-            # phase's ordering): an unsynced own pod would put the node's
-            # own domain in the blocked set and it would hold itself
-            # forever — it must fall through to validate()/unblock and
-            # recover through the normal lifecycle instead.
-            own_synced, own_orphaned = self.pod_in_sync_with_ds(node_state)
-            if (
-                own_synced
-                and not own_orphaned
-                and self.held_at_slice_load_barrier(node_state, blocked_domains)
-            ):
+            # the node is deliberately parked at the barrier.
+            if self.held_at_slice_load_barrier(node_state, blocked_domains):
                 continue
             # The driver may have restarted after entering validation; make
             # sure it is not blocked on safe load (:576-583).
@@ -534,15 +529,10 @@ class CommonUpgradeManager:
         domain units."""
         if slice_aware:
             all_nodes = [ns.node for ns in state.managed_node_states()]
-            idle_states = (
-                consts.UPGRADE_STATE_UNKNOWN,
-                consts.UPGRADE_STATE_DONE,
-                consts.UPGRADE_STATE_UPGRADE_REQUIRED,
-            )
             active_domains = {
                 topology.domain_of(ns.node)
                 for st, nss in state.node_states.items()
-                if st in consts.ALL_STATES and st not in idle_states
+                if st in consts.ACTIVE_STATES
                 for ns in nss
             }
             upgrades_in_progress = len(active_domains)

@@ -71,7 +71,7 @@ class InplaceNodeStateManager:
 
         node_states = state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED)
         if slice_aware:
-            self._schedule_by_domain(node_states, available)
+            self._schedule_by_domain(state, node_states, available)
         else:
             self._schedule_by_node(node_states, available)
 
@@ -112,15 +112,38 @@ class InplaceNodeStateManager:
             available -= 1
 
     def _schedule_by_domain(
-        self, node_states: List[NodeUpgradeState], available: int
+        self,
+        state: ClusterUpgradeState,
+        node_states: List[NodeUpgradeState],
+        available: int,
     ) -> None:
         """Slice-aware scheduling: one slot = one domain; all of a chosen
-        domain's upgrade-required nodes advance together."""
+        domain's upgrade-required nodes advance together.
+
+        A domain with peers already in an active upgrade state admits its
+        upgrade-required stragglers WITHOUT consuming a slot — the domain
+        already holds one, and it is already down as a failure domain, so
+        delaying the stragglers only extends the outage.  This is the
+        domain-granular analog of the reference's cordoned-node throttle
+        bypass (upgrade_inplace.go:87-97), and it is what keeps a
+        crash-split domain (one host admitted, the operator died before
+        writing the peer) from wedging: with maxParallelUpgrades=1 the
+        active half pins the only slot, and in slice-coherent safe-load
+        mode it is parked at the barrier waiting for the very peer the
+        throttle would otherwise never admit."""
         common = self._common
+        active_domains = {
+            topology.domain_of(ns.node)
+            for bucket, nss in state.node_states.items()
+            if bucket in consts.ACTIVE_STATES
+            for ns in nss
+        }
         eligible = [ns for ns in node_states if self._prepare(ns)]
         domains = topology.group_by_domain([ns.node for ns in eligible])
         for domain, nodes in domains.items():
-            bypass = any(common.is_node_unschedulable(n) for n in nodes)
+            bypass = domain in active_domains or any(
+                common.is_node_unschedulable(n) for n in nodes
+            )
             if available <= 0 and not bypass:
                 continue
             for node in nodes:
