@@ -17,7 +17,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .errors import NotFoundError
-from .inmem import InMemoryCluster, JsonObj, Key
+from .inmem import InMemoryCluster, JsonObj, Key, json_copy
 from .selectors import parse_selector
 
 
@@ -58,7 +58,7 @@ class InformerCache:
             obj = self._snapshot.get((kind, namespace, name))
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not in cache")
-            return copy.deepcopy(obj)
+            return json_copy(obj)
 
     def list(
         self, kind: str, namespace: Optional[str] = None, label_selector: str = ""
@@ -76,5 +76,5 @@ class InformerCache:
                     continue
                 labels = (obj.get("metadata") or {}).get("labels") or {}
                 if match(labels):
-                    out.append(copy.deepcopy(obj))
+                    out.append(json_copy(obj))
             return out

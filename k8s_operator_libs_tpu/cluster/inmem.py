@@ -48,6 +48,25 @@ from .selectors import parse_selector
 JsonObj = Dict[str, Any]
 Key = Tuple[str, str, str]  # (kind, namespace, name)
 
+_SCALARS = (str, int, float, bool, type(None))
+
+
+def json_copy(obj: Any) -> Any:
+    """Deep copy for JSON-style trees (dict/list/scalars) — the only shapes
+    this store holds.  ~5x faster than :func:`copy.deepcopy`, which
+    dominates the read path at fleet scale (every get/list copies every
+    returned object under the store lock, so copy cost serializes all
+    readers).  Non-JSON values (tests sometimes stash helper objects on
+    metadata) fall back to ``copy.deepcopy``."""
+    t = type(obj)
+    if t is dict:
+        return {k: json_copy(v) for k, v in obj.items()}
+    if t is list:
+        return [json_copy(v) for v in obj]
+    if t in _SCALARS or isinstance(obj, _SCALARS):
+        return obj
+    return copy.deepcopy(obj)
+
 
 def _key_of(obj: JsonObj) -> Key:
     kind = obj.get("kind")
@@ -67,7 +86,7 @@ def merge_patch(target: JsonObj, patch: JsonObj) -> JsonObj:
         elif isinstance(v, dict) and isinstance(out.get(k), dict):
             out[k] = merge_patch(out[k], v)
         else:
-            out[k] = copy.deepcopy(v)
+            out[k] = json_copy(v)
     return out
 
 
@@ -95,11 +114,42 @@ class InMemoryCluster:
         self._journal_floor = 0  # highest seq evicted from the journal
         #: A real apiserver establishes CRDs asynchronously; 0 = synchronous.
         self.crd_establish_delay_seconds = crd_establish_delay_seconds
+        # Secondary indexes (the apiserver analog: etcd key prefixes per
+        # type + the kubelet's spec.nodeName fieldSelector index).  At
+        # fleet scale every per-node drain/eviction listing otherwise
+        # scans the whole store under the lock — O(fleet²) per wave.
+        self._by_kind: Dict[str, set] = {}
+        self._pods_by_node: Dict[str, set] = {}
 
     # ------------------------------------------------------------------ util
     def _next_rv(self) -> str:
         self._rv += 1
         return str(self._rv)
+
+    # ------------------------------------------------------------ index upkeep
+    def _store_put(self, key: Key, obj: JsonObj) -> None:
+        prev = self._store.get(key)
+        if prev is not None:
+            self._index_drop(key, prev)
+        self._store[key] = obj
+        self._by_kind.setdefault(key[0], set()).add(key)
+        if key[0] == "Pod":
+            node = (obj.get("spec") or {}).get("nodeName") or ""
+            self._pods_by_node.setdefault(node, set()).add(key)
+
+    def _store_pop(self, key: Key) -> Optional[JsonObj]:
+        obj = self._store.pop(key, None)
+        if obj is not None:
+            self._index_drop(key, obj)
+        return obj
+
+    def _index_drop(self, key: Key, obj: JsonObj) -> None:
+        self._by_kind.get(key[0], set()).discard(key)
+        if key[0] == "Pod":
+            node = (obj.get("spec") or {}).get("nodeName") or ""
+            bucket = self._pods_by_node.get(node)
+            if bucket is not None:
+                bucket.discard(key)
 
     def _record(self, type_: str, old: Optional[JsonObj], new: Optional[JsonObj]) -> None:
         self._journal.append(WatchEvent(self._rv, type_, old, new))
@@ -114,14 +164,14 @@ class InMemoryCluster:
             key = _key_of(obj)
             if key in self._store:
                 raise AlreadyExistsError(f"{key} already exists")
-            stored = copy.deepcopy(obj)
+            stored = json_copy(obj)
             meta = stored.setdefault("metadata", {})
             meta["resourceVersion"] = self._next_rv()
             meta.setdefault("uid", str(uuid.uuid4()))
             meta.setdefault("creationTimestamp", time.time())
-            self._store[key] = stored
-            self._record("Added", None, copy.deepcopy(stored))
-            result = copy.deepcopy(stored)
+            self._store_put(key, stored)
+            self._record("Added", None, json_copy(stored))
+            result = json_copy(stored)
         if stored.get("kind") == "CustomResourceDefinition":
             self._schedule_crd_establishment(key)
         return result
@@ -135,7 +185,7 @@ class InMemoryCluster:
                 obj = self._store.get(key)
                 if obj is None:
                     return
-                old = copy.deepcopy(obj)
+                old = json_copy(obj)
                 conds = obj.setdefault("status", {}).setdefault("conditions", [])
                 for c in conds:
                     if c.get("type") == "Established":
@@ -144,7 +194,7 @@ class InMemoryCluster:
                 else:
                     conds.append({"type": "Established", "status": "True"})
                 obj["metadata"]["resourceVersion"] = self._next_rv()
-                self._record("Modified", old, copy.deepcopy(obj))
+                self._record("Modified", old, json_copy(obj))
 
         if self.crd_establish_delay_seconds <= 0:
             establish()
@@ -158,7 +208,7 @@ class InMemoryCluster:
             obj = self._store.get((kind, namespace, name))
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
-            return copy.deepcopy(obj)
+            return json_copy(obj)
 
     def list(
         self,
@@ -166,13 +216,39 @@ class InMemoryCluster:
         namespace: Optional[str] = None,
         label_selector: str = "",
         field_filter: Optional[Callable[[JsonObj], bool]] = None,
+        field_selector: str = "",
     ) -> List[JsonObj]:
+        """List objects of *kind*.  ``field_selector`` supports the one
+        form a real apiserver indexes for pods — ``spec.nodeName=<node>``
+        — and is served from a secondary index (O(pods-on-node), not
+        O(store)).  ``field_filter`` is an arbitrary predicate run on the
+        stored objects BEFORE copying (test/simulation convenience; a real
+        client would filter after the fact)."""
         match = parse_selector(label_selector)
         with self._lock:
-            out = []
-            for (k, ns, _), obj in sorted(self._store.items()):
-                if k != kind:
+            # Candidates come from the narrowest available index; label /
+            # field filters then run on the stored objects FIRST, so only
+            # matches are copied (copying under the store lock is what
+            # serializes concurrent readers at fleet scale).
+            if field_selector:
+                if kind != "Pod" or not field_selector.startswith(
+                    "spec.nodeName="
+                ):
+                    raise BadRequestError(
+                        f"unsupported field selector {field_selector!r} "
+                        f"for kind {kind} (only Pod spec.nodeName=... is "
+                        f"indexed)"
+                    )
+                node = field_selector.split("=", 1)[1]
+                keys = self._pods_by_node.get(node) or ()
+            else:
+                keys = self._by_kind.get(kind) or ()
+            matches = []
+            for key in keys:
+                obj = self._store.get(key)
+                if obj is None:
                     continue
+                _, ns, _name = key
                 if namespace is not None and ns != namespace:
                     continue
                 labels = (obj.get("metadata") or {}).get("labels") or {}
@@ -180,8 +256,9 @@ class InMemoryCluster:
                     continue
                 if field_filter is not None and not field_filter(obj):
                     continue
-                out.append(copy.deepcopy(obj))
-            return out
+                matches.append((key, obj))
+            matches.sort(key=lambda kv: kv[0])
+            return [json_copy(obj) for _, obj in matches]
 
     def update(self, obj: JsonObj) -> JsonObj:
         """Full-object replace with optimistic concurrency on resourceVersion."""
@@ -195,8 +272,8 @@ class InMemoryCluster:
                 raise ConflictError(
                     f"{key}: resourceVersion {sent_rv} != {current['metadata']['resourceVersion']}"
                 )
-            old = copy.deepcopy(current)
-            stored = copy.deepcopy(obj)
+            old = json_copy(current)
+            stored = json_copy(obj)
             stored["metadata"]["uid"] = current["metadata"]["uid"]
             stored["metadata"]["creationTimestamp"] = current["metadata"][
                 "creationTimestamp"
@@ -211,12 +288,12 @@ class InMemoryCluster:
             if stored["metadata"].get("deletionTimestamp") and not stored[
                 "metadata"
             ].get("finalizers"):
-                self._store.pop(key)
+                self._store_pop(key)
                 self._record("Deleted", old, None)
-                return copy.deepcopy(stored)
-            self._store[key] = stored
-            self._record("Modified", old, copy.deepcopy(stored))
-            return copy.deepcopy(stored)
+                return json_copy(stored)
+            self._store_put(key, stored)
+            self._record("Modified", old, json_copy(stored))
+            return json_copy(stored)
 
     #: Status subresource writes share update semantics here (envtest-style
     #: hand-set status — reference upgrade_suit_test.go:344-355, 416-428).
@@ -244,7 +321,7 @@ class InMemoryCluster:
                     f"{key}: patch resourceVersion {sent_rv} != "
                     f"{current['metadata']['resourceVersion']}"
                 )
-            old = copy.deepcopy(current)
+            old = json_copy(current)
             merged = merge_patch(current, patch_body)
             # kind / name / namespace / uid are immutable, like a real apiserver
             merged["kind"] = kind
@@ -260,12 +337,12 @@ class InMemoryCluster:
             if merged["metadata"].get("deletionTimestamp") and not merged[
                 "metadata"
             ].get("finalizers"):
-                self._store.pop(key)
+                self._store_pop(key)
                 self._record("Deleted", old, None)
-                return copy.deepcopy(merged)
-            self._store[key] = merged
-            self._record("Modified", old, copy.deepcopy(merged))
-            return copy.deepcopy(merged)
+                return json_copy(merged)
+            self._store_put(key, merged)
+            self._record("Modified", old, json_copy(merged))
+            return json_copy(merged)
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
         """Delete an object.  Like a real apiserver, an object holding
@@ -279,14 +356,14 @@ class InMemoryCluster:
                 raise NotFoundError(f"{key} not found")
             if (obj.get("metadata") or {}).get("finalizers"):
                 if not obj["metadata"].get("deletionTimestamp"):
-                    old = copy.deepcopy(obj)
+                    old = json_copy(obj)
                     obj["metadata"]["deletionTimestamp"] = time.time()
                     obj["metadata"]["resourceVersion"] = self._next_rv()
-                    self._record("Modified", old, copy.deepcopy(obj))
+                    self._record("Modified", old, json_copy(obj))
                 return
-            self._store.pop(key)
+            self._store_pop(key)
             self._next_rv()  # deletions advance the version sequence too
-            self._record("Deleted", copy.deepcopy(obj), None)
+            self._record("Deleted", json_copy(obj), None)
 
     # ------------------------------------------------------------- watch API
     def journal_seq(self) -> int:
@@ -317,7 +394,7 @@ class InMemoryCluster:
     def snapshot(self) -> Dict[Key, JsonObj]:
         """Deep-copied point-in-time view of the whole store (informer sync)."""
         with self._lock:
-            return copy.deepcopy(self._store)
+            return json_copy(self._store)
 
     # ------------------------------------------------------- persistence API
     def to_dict(self) -> JsonObj:
@@ -325,7 +402,7 @@ class InMemoryCluster:
         with self._lock:
             return {
                 "rv": self._rv,
-                "objects": list(copy.deepcopy(self._store).values()),
+                "objects": list(json_copy(self._store).values()),
             }
 
     @classmethod
@@ -341,7 +418,7 @@ class InMemoryCluster:
             cluster._rv = int(data.get("rv", 0))
             for obj in data.get("objects", []):
                 key = _key_of(obj)
-                cluster._store[key] = copy.deepcopy(obj)
+                cluster._store_put(key, json_copy(obj))
         for obj in data.get("objects", []):
             if obj.get("kind") == "CustomResourceDefinition":
                 conds = (obj.get("status") or {}).get("conditions") or []

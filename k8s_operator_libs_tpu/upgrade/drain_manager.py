@@ -91,9 +91,12 @@ class DrainHelper:
         selector = parse_selector(cfg.pod_selector)
         pods: List[JsonObj] = []
         errors: List[str] = []
-        for pod in self._cluster.list("Pod"):
-            if (pod.get("spec") or {}).get("nodeName") != node_name:
-                continue
+        # the apiserver-side spec.nodeName fieldSelector a real drain uses,
+        # served from the store's pods-by-node index
+        node_pods = self._cluster.list(
+            "Pod", field_selector=f"spec.nodeName={node_name}"
+        )
+        for pod in node_pods:
             labels = (pod.get("metadata") or {}).get("labels") or {}
             if not selector(labels):
                 continue

@@ -32,7 +32,6 @@ from ..cluster.objects import (
     is_owned_by,
     name_of,
     namespace_of,
-    pod_node_name,
     pod_phase,
 )
 from . import consts, util
@@ -153,11 +152,9 @@ class PodManager:
         name = name_of(node)
         try:
             try:
-                pods_on_node = [
-                    p
-                    for p in self._cluster.list("Pod")
-                    if pod_node_name(p) == name
-                ]
+                pods_on_node = self._cluster.list(
+                    "Pod", field_selector=f"spec.nodeName={name}"
+                )
                 to_delete = [
                     p for p in pods_on_node if self._filter and self._filter(p)
                 ]
@@ -267,13 +264,11 @@ class PodManager:
             raise PodManagerError("wait-for-completion spec required")
         for node in config.nodes:
             name = name_of(node)
-            pods = [
-                p
-                for p in self._cluster.list(
-                    "Pod", label_selector=spec.pod_selector
-                )
-                if pod_node_name(p) == name
-            ]
+            pods = self._cluster.list(
+                "Pod",
+                label_selector=spec.pod_selector,
+                field_selector=f"spec.nodeName={name}",
+            )
             running = any(self.is_pod_running_or_pending(p) for p in pods)
             if running:
                 if spec.timeout_second != 0:

@@ -14,7 +14,7 @@ import time
 from typing import Optional
 
 from ..cluster.inmem import InMemoryCluster, JsonObj
-from ..cluster.objects import name_of, pod_node_name, pod_phase
+from ..cluster.objects import name_of, pod_phase
 from . import consts, util
 from .node_upgrade_state_provider import NodeUpgradeStateProvider
 from .util import EventRecorder, log_event
@@ -46,11 +46,11 @@ class ValidationManager:
         if not self.pod_selector:
             return True
         name = name_of(node)
-        pods = [
-            p
-            for p in self._cluster.list("Pod", label_selector=self.pod_selector)
-            if pod_node_name(p) == name
-        ]
+        pods = self._cluster.list(
+            "Pod",
+            label_selector=self.pod_selector,
+            field_selector=f"spec.nodeName={name}",
+        )
         if not pods:
             logger.warning(
                 "no validation pods found on node %s (selector %r)",

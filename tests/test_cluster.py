@@ -263,3 +263,79 @@ class TestRetryOnConflict:
         with pytest.raises(ConflictError):
             retry_on_conflict(always_conflict, steps=3, base_seconds=0.0)
         assert calls["n"] == 3
+
+
+class TestStoreIndexes:
+    """The secondary indexes behind list(): per-kind keys and the
+    spec.nodeName fieldSelector index for pods.  These must track every
+    mutation path (create / update / patch / delete / finalizer
+    removal) or list() silently returns stale/missing objects."""
+
+    def test_field_selector_lists_only_that_nodes_pods(self):
+        cluster = InMemoryCluster()
+        cluster.create(make_pod("p1", "ns", "n1"))
+        cluster.create(make_pod("p2", "ns", "n2"))
+        cluster.create(make_pod("p3", "ns", "n1"))
+        names = {
+            p["metadata"]["name"]
+            for p in cluster.list("Pod", field_selector="spec.nodeName=n1")
+        }
+        assert names == {"p1", "p3"}
+
+    def test_field_selector_tracks_node_reassignment_via_update(self):
+        cluster = InMemoryCluster()
+        pod = cluster.create(make_pod("p1", "ns", "n1"))
+        pod["spec"]["nodeName"] = "n2"
+        cluster.update(pod)
+        assert cluster.list("Pod", field_selector="spec.nodeName=n1") == []
+        assert [
+            p["metadata"]["name"]
+            for p in cluster.list("Pod", field_selector="spec.nodeName=n2")
+        ] == ["p1"]
+
+    def test_index_tracks_delete_and_finalizer_removal(self):
+        cluster = InMemoryCluster()
+        pod = cluster.create(make_pod("p1", "ns", "n1"))
+        pod["metadata"]["finalizers"] = ["keep"]
+        pod = cluster.update(pod)
+        cluster.delete("Pod", "p1", "ns")  # only marked: finalizer held
+        assert len(cluster.list("Pod", field_selector="spec.nodeName=n1")) == 1
+        pod = cluster.get("Pod", "p1", "ns")
+        pod["metadata"]["finalizers"] = []
+        cluster.update(pod)  # finalizer cleared → actually removed
+        assert cluster.list("Pod", field_selector="spec.nodeName=n1") == []
+        assert cluster.list("Pod") == []
+
+    def test_unsupported_field_selector_rejected(self):
+        from k8s_operator_libs_tpu.cluster.errors import BadRequestError
+
+        cluster = InMemoryCluster()
+        with pytest.raises(BadRequestError):
+            cluster.list("Pod", field_selector="status.phase=Running")
+        with pytest.raises(BadRequestError):
+            cluster.list("Node", field_selector="spec.nodeName=n1")
+
+    def test_from_dict_rebuilds_indexes(self):
+        cluster = InMemoryCluster()
+        cluster.create(make_pod("p1", "ns", "n1"))
+        cluster.create(make_node("n1"))
+        restored = InMemoryCluster.from_dict(cluster.to_dict())
+        assert [
+            p["metadata"]["name"]
+            for p in restored.list("Pod", field_selector="spec.nodeName=n1")
+        ] == ["p1"]
+        assert len(restored.list("Node")) == 1
+
+    def test_returned_objects_are_isolated_copies(self):
+        """json_copy contract: mutating a returned object never leaks into
+        the store (client-go cache-copy discipline)."""
+        cluster = InMemoryCluster()
+        cluster.create(make_pod("p1", "ns", "n1"))
+        got = cluster.get("Pod", "p1", "ns")
+        got["metadata"]["labels"] = {"mutated": "yes"}
+        got["status"]["containerStatuses"] = [{"name": "x", "ready": False}]
+        fresh = cluster.get("Pod", "p1", "ns")
+        assert "mutated" not in (fresh["metadata"].get("labels") or {})
+        assert fresh["status"].get("containerStatuses") != got["status"][
+            "containerStatuses"
+        ]
