@@ -1,0 +1,107 @@
+"""Command-line entry point: ``python -m k8s_operator_libs_tpu``.
+
+Subcommands:
+
+* ``status`` — compute and print the rollout status
+  (:mod:`.upgrade.rollout_status`) from a persisted cluster dump (the
+  ``--state-file`` JSON the example CLIs write, see
+  ``examples/apply_crds.py``).  The reference has no equivalent;
+  consumers grep node labels by hand.
+
+      python -m k8s_operator_libs_tpu status --state-file /tmp/cluster.json \\
+          --namespace tpu-ops --selector app=tpu-runtime --component tpu-runtime
+      python -m k8s_operator_libs_tpu status --state-file ... --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .cluster.inmem import InMemoryCluster
+from .upgrade import util
+from .upgrade.rollout_status import RolloutStatus
+from .upgrade.upgrade_state import ClusterUpgradeStateManager
+
+
+def _parse_selector_arg(selector: str) -> dict:
+    labels = {}
+    for part in selector.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise SystemExit(f"invalid selector term {part!r} (want key=value)")
+        k, v = part.split("=", 1)
+        labels[k] = v
+    return labels
+
+
+def cmd_status(args: argparse.Namespace) -> int:
+    try:
+        with open(args.state_file, "r", encoding="utf-8") as fh:
+            cluster = InMemoryCluster.from_dict(json.load(fh))
+    except FileNotFoundError:
+        print(f"state file not found: {args.state_file}", file=sys.stderr)
+        return 2
+    except (json.JSONDecodeError, KeyError, TypeError, ValueError) as err:
+        print(
+            f"state file {args.state_file} is not a cluster dump: {err}",
+            file=sys.stderr,
+        )
+        return 2
+    util.set_component_name(args.component)
+    manager = ClusterUpgradeStateManager(cluster)
+    state = manager.build_state(
+        args.namespace, _parse_selector_arg(args.selector)
+    )
+    status = RolloutStatus.from_cluster_state(state)
+    if args.json:
+        print(json.dumps(status.to_dict()))
+    else:
+        print(status.render())
+    # kubectl-rollout-status convention: nonzero while not complete lets
+    # scripts poll `status` until the rollout finishes
+    return 0 if status.complete or not args.wait_exit_code else 3
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m k8s_operator_libs_tpu",
+        description="TPU-fleet orchestration library CLI",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    st = sub.add_parser("status", help="print rollout status")
+    st.add_argument("--state-file", required=True, help="cluster dump JSON")
+    st.add_argument("--namespace", default="tpu-ops")
+    st.add_argument(
+        "--selector",
+        default="app=tpu-runtime",
+        help="driver DaemonSet label selector, key=value[,key=value...]",
+    )
+    st.add_argument(
+        "--component",
+        default="tpu-runtime",
+        help="managed component name (parameterizes the label keys)",
+    )
+    st.add_argument("--json", action="store_true", help="machine output")
+    st.add_argument(
+        "--wait-exit-code",
+        action="store_true",
+        help="exit 3 while the rollout is incomplete (poll-friendly)",
+    )
+    st.set_defaults(func=cmd_status)
+
+    args = parser.parse_args(argv)
+    try:
+        return args.func(args)
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe — normal CLI termination
+        sys.stderr.close()
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

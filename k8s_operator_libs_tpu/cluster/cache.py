@@ -11,7 +11,6 @@ from a point-in-time snapshot that only refreshes when older than
 
 from __future__ import annotations
 
-import copy
 import threading
 import time
 from typing import Any, Dict, List, Optional

@@ -36,6 +36,7 @@ from .upgrade_requestor import (
     get_requestor_opts_from_envs,
     new_requestor_id_predicate,
 )
+from .rollout_status import DomainStatus, RolloutStatus
 from .upgrade_state import ClusterUpgradeStateManager, UpgradeStateError
 from .validation_manager import ValidationManager
 
@@ -70,4 +71,6 @@ __all__ = [
     "ClusterUpgradeStateManager",
     "UpgradeStateError",
     "ValidationManager",
+    "DomainStatus",
+    "RolloutStatus",
 ]

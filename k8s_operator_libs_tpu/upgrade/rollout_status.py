@@ -1,0 +1,178 @@
+"""RolloutStatus — aggregate, per-domain rollout introspection.
+
+The reference sketches an aggregate-progress event but leaves it
+commented out (upgrade_state.go:199-202) and offers no programmatic way
+to ask "how far along is the rollout?" — consumers are left grepping
+node labels.  This module finishes that capability as a first-class
+read-only API over the same :class:`~.common_manager.ClusterUpgradeState`
+snapshot the state machine processes, plus the TPU domain grouping
+(:mod:`..tpu.topology`): per-state node counts, done/in-progress/pending/
+failed totals, and a per-slice-domain breakdown showing exactly which
+slices are mid-wave, blocked, or finished.
+
+Pure functions over the snapshot — no writes, no extra API calls — so an
+operator can compute it in the same reconcile that built the state, and
+the CLI (``python -m k8s_operator_libs_tpu status``) can compute it from
+a persisted cluster dump.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from ..tpu import topology
+from . import consts
+
+#: Terminal/OK state for display purposes.
+_DONE = consts.UPGRADE_STATE_DONE
+
+
+@dataclass
+class DomainStatus:
+    """One atomic unavailability domain (slice, multislice group, or
+    singleton node) and where its hosts are in the lifecycle."""
+
+    domain: str
+    singleton: bool
+    nodes: int = 0
+    by_state: Dict[str, int] = field(default_factory=dict)
+    unavailable: bool = False
+
+    @property
+    def done(self) -> bool:
+        return self.by_state.get(_DONE, 0) == self.nodes
+
+    @property
+    def active(self) -> bool:
+        return any(
+            state in consts.ACTIVE_STATES for state in self.by_state
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "domain": self.domain,
+            "singleton": self.singleton,
+            "nodes": self.nodes,
+            "byState": dict(self.by_state),
+            "unavailable": self.unavailable,
+            "done": self.done,
+            "active": self.active,
+        }
+
+
+@dataclass
+class RolloutStatus:
+    """Point-in-time aggregate of a rollout."""
+
+    total_nodes: int
+    by_state: Dict[str, int]
+    done: int
+    in_progress: int
+    pending: int
+    failed: int
+    domains: List[DomainStatus]
+
+    # ------------------------------------------------------------- derived
+    @property
+    def percent_done(self) -> float:
+        # 0 nodes means the selector matched nothing (misconfiguration or a
+        # pre-rollout dump) — report 0%, consistent with complete=False.
+        if self.total_nodes == 0:
+            return 0.0
+        return 100.0 * self.done / self.total_nodes
+
+    @property
+    def complete(self) -> bool:
+        return self.total_nodes > 0 and self.done == self.total_nodes
+
+    @property
+    def total_domains(self) -> int:
+        return len(self.domains)
+
+    @property
+    def domains_done(self) -> int:
+        return sum(1 for d in self.domains if d.done)
+
+    # --------------------------------------------------------- construction
+    @classmethod
+    def from_cluster_state(cls, state) -> "RolloutStatus":
+        """Compute from a :class:`~.common_manager.ClusterUpgradeState`
+        snapshot (the object ``build_state`` returns)."""
+        by_state: Dict[str, int] = {}
+        domains: Dict[str, DomainStatus] = {}
+        total = done = in_progress = pending = failed = 0
+        for bucket, node_states in state.node_states.items():
+            # UPGRADE_STATE_UNKNOWN is the empty string; surface it under a
+            # readable key so JSON consumers don't special-case "".
+            label = bucket or "unknown"
+            for ns in node_states:
+                total += 1
+                by_state[label] = by_state.get(label, 0) + 1
+                if bucket == _DONE:
+                    done += 1
+                elif bucket == consts.UPGRADE_STATE_UPGRADE_REQUIRED:
+                    pending += 1
+                elif bucket in consts.ACTIVE_STATES:
+                    in_progress += 1
+                if bucket == consts.UPGRADE_STATE_FAILED:
+                    failed += 1
+                dom = topology.domain_of(ns.node)
+                ds = domains.get(dom)
+                if ds is None:
+                    ds = domains[dom] = DomainStatus(
+                        domain=dom,
+                        singleton=topology.is_singleton_domain(dom),
+                    )
+                ds.nodes += 1
+                ds.by_state[label] = ds.by_state.get(label, 0) + 1
+                if topology.node_is_unavailable(ns.node):
+                    ds.unavailable = True
+        return cls(
+            total_nodes=total,
+            by_state=by_state,
+            done=done,
+            in_progress=in_progress,
+            pending=pending,
+            failed=failed,
+            domains=sorted(domains.values(), key=lambda d: d.domain),
+        )
+
+    # -------------------------------------------------------------- output
+    def to_dict(self) -> dict:
+        return {
+            "totalNodes": self.total_nodes,
+            "byState": dict(self.by_state),
+            "done": self.done,
+            "inProgress": self.in_progress,
+            "pending": self.pending,
+            "failed": self.failed,
+            "percentDone": round(self.percent_done, 1),
+            "complete": self.complete,
+            "domains": [d.to_dict() for d in self.domains],
+        }
+
+    def summary(self) -> str:
+        """One-line progress summary (the kubectl-rollout-status analog)."""
+        return (
+            f"done {self.done}/{self.total_nodes} nodes "
+            f"({self.domains_done}/{self.total_domains} domains, "
+            f"{self.percent_done:.0f}%) — "
+            f"inProgress {self.in_progress} pending {self.pending} "
+            f"failed {self.failed}"
+        )
+
+    def render(self) -> str:
+        """Multi-line human table: the summary plus one row per domain."""
+        lines = [self.summary(), ""]
+        header = f"{'DOMAIN':<28} {'NODES':>5} {'UNAVAIL':>7}  STATES"
+        lines.append(header)
+        for d in self.domains:
+            states = ", ".join(
+                f"{state}={n}" for state, n in sorted(d.by_state.items())
+            )
+            lines.append(
+                f"{d.domain:<28} {d.nodes:>5} "
+                f"{'yes' if d.unavailable else 'no':>7}  {states}"
+            )
+        return "\n".join(lines)

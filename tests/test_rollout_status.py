@@ -1,0 +1,185 @@
+"""RolloutStatus API + `python -m k8s_operator_libs_tpu status` CLI."""
+
+import json
+
+import pytest
+
+from k8s_operator_libs_tpu.__main__ import main as cli_main
+from k8s_operator_libs_tpu.cluster.objects import set_condition
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    RolloutStatus,
+    consts,
+    util,
+)
+
+from harness import DRIVER_LABELS, NAMESPACE, Fleet
+
+SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
+STATE_KEY_OF = util.get_upgrade_state_label_key
+
+
+def _mixed_fleet(cluster):
+    """2-host slice mid-wave + singleton done + singleton failed."""
+    fleet = Fleet(cluster)
+    fleet.add_node(
+        "s0-h0", pod_hash="rev1", labels={SLICE_KEY: "s0"}, unschedulable=True
+    )
+    fleet.add_node("s0-h1", pod_hash="rev1", labels={SLICE_KEY: "s0"})
+    fleet.add_node("done-node")
+    fleet.add_node("sick", pod_hash="rev1")
+    fleet.publish_new_revision("rev2")
+    states = {
+        "s0-h0": consts.UPGRADE_STATE_DRAIN_REQUIRED,
+        "s0-h1": consts.UPGRADE_STATE_CORDON_REQUIRED,
+        "done-node": consts.UPGRADE_STATE_DONE,
+        "sick": consts.UPGRADE_STATE_FAILED,
+    }
+    for name, st in states.items():
+        cluster.patch(
+            "Node", name, {"metadata": {"labels": {STATE_KEY_OF(): st}}}
+        )
+    return fleet
+
+
+def _status(cluster):
+    manager = ClusterUpgradeStateManager(cluster)
+    state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+    return RolloutStatus.from_cluster_state(state)
+
+
+class TestRolloutStatus:
+    def test_aggregate_counts(self, cluster):
+        _mixed_fleet(cluster)
+        s = _status(cluster)
+        assert s.total_nodes == 4
+        assert s.done == 1
+        assert s.failed == 1
+        assert s.in_progress == 3  # 2 slice hosts + failed (active census)
+        assert s.pending == 0
+        assert not s.complete
+        assert s.percent_done == pytest.approx(25.0)
+
+    def test_domain_breakdown(self, cluster):
+        _mixed_fleet(cluster)
+        s = _status(cluster)
+        assert s.total_domains == 3
+        by_name = {d.domain: d for d in s.domains}
+        slice_dom = by_name["s0"]
+        assert slice_dom.nodes == 2
+        assert slice_dom.unavailable  # h0 is cordoned
+        assert slice_dom.active and not slice_dom.done
+        assert by_name["node:done-node"].done
+        assert by_name["node:done-node"].singleton
+
+    def test_complete_fleet(self, cluster):
+        fleet = Fleet(cluster)
+        fleet.add_node("n1")
+        cluster.patch(
+            "Node",
+            "n1",
+            {"metadata": {"labels": {STATE_KEY_OF(): consts.UPGRADE_STATE_DONE}}},
+        )
+        s = _status(cluster)
+        assert s.complete and s.percent_done == 100.0
+
+    def test_not_ready_node_marks_domain_unavailable(self, cluster):
+        fleet = Fleet(cluster)
+        fleet.add_node("s0-h0", labels={SLICE_KEY: "s0"})
+        fleet.add_node("s0-h1", labels={SLICE_KEY: "s0"})
+        node = cluster.get("Node", "s0-h1")
+        set_condition(node, "Ready", "False")
+        cluster.update(node)
+        s = _status(cluster)
+        assert s.domains[0].unavailable
+
+    def test_render_and_dict(self, cluster):
+        _mixed_fleet(cluster)
+        s = _status(cluster)
+        text = s.render()
+        assert "DOMAIN" in text and "s0" in text and "drain-required=1" in text
+        d = s.to_dict()
+        assert d["totalNodes"] == 4 and len(d["domains"]) == 3
+        assert d["byState"][consts.UPGRADE_STATE_FAILED] == 1
+
+
+class TestStatusCli:
+    def _dump(self, cluster, tmp_path):
+        path = tmp_path / "cluster.json"
+        path.write_text(json.dumps(cluster.to_dict()))
+        return str(path)
+
+    def test_table_output(self, cluster, tmp_path, capsys):
+        _mixed_fleet(cluster)
+        rc = cli_main(
+            ["status", "--state-file", self._dump(cluster, tmp_path)]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "done 1/4 nodes" in out
+        assert "s0" in out
+
+    def test_json_output(self, cluster, tmp_path, capsys):
+        _mixed_fleet(cluster)
+        rc = cli_main(
+            ["status", "--state-file", self._dump(cluster, tmp_path), "--json"]
+        )
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert data["done"] == 1 and data["failed"] == 1
+
+    def test_wait_exit_code(self, cluster, tmp_path, capsys):
+        _mixed_fleet(cluster)
+        rc = cli_main(
+            [
+                "status",
+                "--state-file",
+                self._dump(cluster, tmp_path),
+                "--wait-exit-code",
+            ]
+        )
+        assert rc == 3  # rollout incomplete
+
+    def test_missing_state_file(self, tmp_path, capsys):
+        rc = cli_main(
+            ["status", "--state-file", str(tmp_path / "nope.json")]
+        )
+        assert rc == 2
+
+    def test_corrupt_state_file(self, tmp_path, capsys):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        rc = cli_main(["status", "--state-file", str(bad)])
+        assert rc == 2
+        assert "not a cluster dump" in capsys.readouterr().err
+
+    def test_empty_selection_reports_zero_percent(
+        self, cluster, tmp_path, capsys
+    ):
+        """A selector matching nothing must not claim 100% done while the
+        wait exit code says incomplete."""
+        _mixed_fleet(cluster)
+        rc = cli_main(
+            [
+                "status",
+                "--state-file",
+                self._dump(cluster, tmp_path),
+                "--selector",
+                "app=no-such-driver",
+                "--json",
+            ]
+        )
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert data["percentDone"] == 0.0 and data["complete"] is False
+
+    def test_unknown_state_keyed_readably_in_json(
+        self, cluster, tmp_path, capsys
+    ):
+        fleet = Fleet(cluster)
+        fleet.add_node("fresh")  # no state label yet
+        cli_main(
+            ["status", "--state-file", self._dump(cluster, tmp_path), "--json"]
+        )
+        data = json.loads(capsys.readouterr().out)
+        assert data["byState"] == {"unknown": 1}
