@@ -98,9 +98,11 @@ def main(argv=None) -> int:
     try:
         return args.func(args)
     except BrokenPipeError:
-        # downstream pager/head closed the pipe — normal CLI termination
+        # Downstream pager/head closed the pipe.  Exit 141 (128+SIGPIPE,
+        # the shell convention) — NOT 0, which --wait-exit-code consumers
+        # would misread as "rollout complete".
         sys.stderr.close()
-        return 0
+        return 141
 
 
 if __name__ == "__main__":

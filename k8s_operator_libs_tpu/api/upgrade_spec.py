@@ -192,6 +192,9 @@ class UpgradePolicySpec:
     #: Count unavailability in slice domains (atomic ICI groups) not nodes.
     slice_aware: bool = False
     pre_drain_checkpoint: Optional[PreDrainCheckpointSpec] = None
+    #: Refuse to START upgrading a domain with a degraded TPU host (see
+    #: tpu.health); domains already mid-upgrade finish.
+    quarantine_degraded: bool = False
 
     def __post_init__(self) -> None:
         if isinstance(self.max_unavailable, (int, str)):
@@ -228,6 +231,8 @@ class UpgradePolicySpec:
             out["sliceAware"] = True
         if self.pre_drain_checkpoint is not None:
             out["preDrainCheckpoint"] = self.pre_drain_checkpoint.to_dict()
+        if self.quarantine_degraded:
+            out["quarantineDegraded"] = True
         return out
 
     @classmethod
@@ -258,4 +263,5 @@ class UpgradePolicySpec:
                 if d.get("preDrainCheckpoint") is not None
                 else None
             ),
+            quarantine_degraded=d.get("quarantineDegraded", False),
         )

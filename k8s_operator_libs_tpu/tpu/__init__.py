@@ -1,6 +1,7 @@
-"""TPU-native extensions: slice topology, checkpoint-drain, demo workload.
+"""TPU-native extensions: slice topology, health, checkpoint-drain, workload.
 
 * :mod:`.topology`        — slice/failure-domain grouping for the throttle
+* :mod:`.health`          — degraded-TPU detection + domain quarantine
 * :mod:`.drain_handshake` — checkpoint-on-drain annotation protocol
 * :mod:`.workload`        — demo SPMD JAX trainer integrating both
   (imported lazily: ``from k8s_operator_libs_tpu.tpu import workload`` —
@@ -8,6 +9,14 @@
 """
 
 from . import topology
+from . import health
 from .drain_handshake import CheckpointDrainGate, DrainSignalWatcher
+from .health import SliceHealthManager
 
-__all__ = ["topology", "CheckpointDrainGate", "DrainSignalWatcher"]
+__all__ = [
+    "topology",
+    "health",
+    "CheckpointDrainGate",
+    "DrainSignalWatcher",
+    "SliceHealthManager",
+]

@@ -128,6 +128,11 @@ UPGRADE_VALIDATION_START_TIME_ANNOTATION_KEY_FMT = (
     DOMAIN + "/%s-upgrade.validation-start-time"
 )
 
+#: TPU-native: node annotation marking the host's slice domain as
+#: quarantined because a domain member has a degraded TPU (value = the
+#: domain id); maintained by tpu.health.SliceHealthManager.
+UPGRADE_QUARANTINE_ANNOTATION_KEY_FMT = DOMAIN + "/%s-upgrade.quarantine"
+
 #: Node annotation marking that this node's upgrade is being handled in
 #: requestor (maintenance-operator) mode (reference: util.go:134-138).
 UPGRADE_REQUESTOR_MODE_ANNOTATION_KEY_FMT = DOMAIN + "/%s-upgrade.requestor-mode"

@@ -21,7 +21,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List
 
-from ..tpu import topology
+from ..tpu import health, topology
 from . import consts
 
 #: Terminal/OK state for display purposes.
@@ -38,6 +38,8 @@ class DomainStatus:
     nodes: int = 0
     by_state: Dict[str, int] = field(default_factory=dict)
     unavailable: bool = False
+    #: A member host has a degraded TPU (see :mod:`..tpu.health`).
+    degraded: bool = False
 
     @property
     def done(self) -> bool:
@@ -56,6 +58,7 @@ class DomainStatus:
             "nodes": self.nodes,
             "byState": dict(self.by_state),
             "unavailable": self.unavailable,
+            "degraded": self.degraded,
             "done": self.done,
             "active": self.active,
         }
@@ -63,7 +66,14 @@ class DomainStatus:
 
 @dataclass
 class RolloutStatus:
-    """Point-in-time aggregate of a rollout."""
+    """Point-in-time aggregate of a rollout.
+
+    Counter semantics (matching the census the throttle uses,
+    common_manager.go:730-737): ``failed`` is a SUBSET of
+    ``in_progress`` — a failed node still occupies an active-state
+    bucket and a throttle slot until it self-heals or is repaired.  So
+    ``done + in_progress + pending (+ unknown) == total_nodes``, and
+    consumers must NOT additionally subtract ``failed``."""
 
     total_nodes: int
     by_state: Dict[str, int]
@@ -128,6 +138,8 @@ class RolloutStatus:
                 ds.by_state[label] = ds.by_state.get(label, 0) + 1
                 if topology.node_is_unavailable(ns.node):
                     ds.unavailable = True
+                if health.node_is_degraded(ns.node):
+                    ds.degraded = True
         return cls(
             total_nodes=total,
             by_state=by_state,
@@ -158,14 +170,16 @@ class RolloutStatus:
             f"done {self.done}/{self.total_nodes} nodes "
             f"({self.domains_done}/{self.total_domains} domains, "
             f"{self.percent_done:.0f}%) — "
-            f"inProgress {self.in_progress} pending {self.pending} "
-            f"failed {self.failed}"
+            f"inProgress {self.in_progress} "
+            f"(of which failed {self.failed}) pending {self.pending}"
         )
 
     def render(self) -> str:
         """Multi-line human table: the summary plus one row per domain."""
         lines = [self.summary(), ""]
-        header = f"{'DOMAIN':<28} {'NODES':>5} {'UNAVAIL':>7}  STATES"
+        header = (
+            f"{'DOMAIN':<28} {'NODES':>5} {'UNAVAIL':>7} {'DEGRADED':>8}  STATES"
+        )
         lines.append(header)
         for d in self.domains:
             states = ", ".join(
@@ -173,6 +187,7 @@ class RolloutStatus:
             )
             lines.append(
                 f"{d.domain:<28} {d.nodes:>5} "
-                f"{'yes' if d.unavailable else 'no':>7}  {states}"
+                f"{'yes' if d.unavailable else 'no':>7} "
+                f"{'yes' if d.degraded else 'no':>8}  {states}"
             )
         return "\n".join(lines)

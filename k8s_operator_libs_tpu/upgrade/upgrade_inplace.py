@@ -70,10 +70,35 @@ class InplaceNodeStateManager:
         )
 
         node_states = state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        quarantined = self._quarantined_domains(state, policy)
         if slice_aware:
-            self._schedule_by_domain(state, node_states, available)
+            self._schedule_by_domain(state, node_states, available, quarantined)
         else:
-            self._schedule_by_node(node_states, available)
+            self._schedule_by_node(node_states, available, quarantined)
+
+    def _quarantined_domains(
+        self, state: ClusterUpgradeState, policy: UpgradePolicySpec
+    ):
+        """Domains barred from STARTING an upgrade because a member host
+        has a degraded TPU (policy.quarantine_degraded; see tpu.health).
+        Returns None when the policy is off — no scan, no behavior change.
+
+        Sources, unioned: live degradation signals (conditions/labels)
+        AND the quarantine annotation SliceHealthManager maintains — so a
+        manually stamped quarantine is honored even when no live signal
+        is present."""
+        if not policy.quarantine_degraded:
+            return None
+        from ..tpu import health, topology as topo
+
+        quarantine_key = util.get_quarantine_annotation_key()
+        nodes = [ns.node for ns in state.all_node_states()]
+        out = health.degraded_domains(nodes)
+        for node in nodes:
+            annotations = (node.get("metadata") or {}).get("annotations") or {}
+            if annotations.get(quarantine_key):
+                out.add(topo.domain_of(node))
+        return out
 
     def _prepare(self, node_state: NodeUpgradeState) -> bool:
         """Annotation/skip handling; returns False if the node must be
@@ -95,13 +120,22 @@ class InplaceNodeStateManager:
         return True
 
     def _schedule_by_node(
-        self, node_states: List[NodeUpgradeState], available: int
+        self,
+        node_states: List[NodeUpgradeState],
+        available: int,
+        quarantined=None,
     ) -> None:
         common = self._common
         for node_state in node_states:
             if not self._prepare(node_state):
                 continue
             node = node_state.node
+            if quarantined and topology.domain_of(node) in quarantined:
+                logger.info(
+                    "node %s is quarantined (degraded domain), not admitting",
+                    (node.get("metadata") or {}).get("name", ""),
+                )
+                continue
             if available <= 0 and not common.is_node_unschedulable(node):
                 # Limit reached; only manually-cordoned nodes may proceed
                 # (reference :87-97).
@@ -116,6 +150,7 @@ class InplaceNodeStateManager:
         state: ClusterUpgradeState,
         node_states: List[NodeUpgradeState],
         available: int,
+        quarantined=None,
     ) -> None:
         """Slice-aware scheduling: one slot = one domain; all of a chosen
         domain's upgrade-required nodes advance together.
@@ -144,6 +179,14 @@ class InplaceNodeStateManager:
             bypass = domain in active_domains or any(
                 common.is_node_unschedulable(n) for n in nodes
             )
+            # Quarantine bars STARTING a degraded domain; an already-active
+            # domain still finishes (stranding it half-upgraded is worse).
+            if quarantined and domain in quarantined and domain not in active_domains:
+                logger.info(
+                    "domain %s is quarantined (degraded host), not admitting",
+                    domain,
+                )
+                continue
             if available <= 0 and not bypass:
                 continue
             for node in nodes:

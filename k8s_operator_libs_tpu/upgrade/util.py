@@ -175,6 +175,11 @@ def get_pre_drain_checkpoint_annotation_key() -> str:
     return consts.PRE_DRAIN_CHECKPOINT_ANNOTATION_KEY_FMT % get_component_name()
 
 
+def get_quarantine_annotation_key() -> str:
+    """TPU-native: degraded-domain quarantine annotation key."""
+    return consts.UPGRADE_QUARANTINE_ANNOTATION_KEY_FMT % get_component_name()
+
+
 def get_event_reason() -> str:
     """Reference: GetEventReason (util.go:157-160)."""
     return "%sUpgrade" % get_component_name()

@@ -1,0 +1,271 @@
+"""Slice health: degraded detection, quarantine reconciler, admission bar."""
+
+import pytest
+
+from k8s_operator_libs_tpu import metrics
+from k8s_operator_libs_tpu.api import DrainSpec, IntOrString, UpgradePolicySpec
+from k8s_operator_libs_tpu.cluster.objects import (
+    get_annotation,
+    make_node,
+    set_condition,
+)
+from k8s_operator_libs_tpu.tpu import SliceHealthManager, health
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    RolloutStatus,
+    consts,
+    util,
+)
+
+from harness import DRIVER_LABELS, NAMESPACE, Fleet
+
+SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
+QKEY = util.get_quarantine_annotation_key
+
+
+class TestDegradedDetection:
+    def test_condition_based(self):
+        node = make_node("n1")
+        assert not health.node_is_degraded(node)
+        set_condition(node, "TpuDegraded", "True")
+        assert health.node_is_degraded(node)
+        set_condition(node, "TpuDegraded", "False")
+        assert not health.node_is_degraded(node)
+
+    def test_label_based(self):
+        node = make_node("n1", labels={health.DEGRADED_LABEL_KEYS[0]: "true"})
+        assert health.node_is_degraded(node)
+        node = make_node("n2", labels={health.DEGRADED_LABEL_KEYS[0]: "false"})
+        assert not health.node_is_degraded(node)
+
+    def test_degraded_domains_groups_by_slice(self):
+        good = make_node("a", labels={SLICE_KEY: "s0"})
+        bad = make_node("b", labels={SLICE_KEY: "s1"})
+        set_condition(bad, "TpuLinkDown", "True")
+        solo = make_node("c")
+        assert health.degraded_domains([good, bad, solo]) == {"s1"}
+
+
+class TestSliceHealthManager:
+    def test_quarantine_stamped_on_whole_domain_and_lifted(self, cluster, recorder):
+        for h in range(2):
+            cluster.create(make_node(f"s0-h{h}", labels={SLICE_KEY: "s0"}))
+        cluster.create(make_node("solo"))
+        sick = cluster.get("Node", "s0-h0")
+        set_condition(sick, "TpuDegraded", "True")
+        cluster.update(sick)
+
+        mgr = SliceHealthManager(cluster, recorder)
+        assert mgr.reconcile() == {"s0"}
+        # BOTH hosts of the domain are stamped; the healthy solo is not
+        assert get_annotation(cluster.get("Node", "s0-h0"), QKEY()) == "s0"
+        assert get_annotation(cluster.get("Node", "s0-h1"), QKEY()) == "s0"
+        assert not get_annotation(cluster.get("Node", "solo"), QKEY())
+        assert (
+            metrics.default_registry()
+            .gauge("degraded_domains", "")
+            .value()
+            == 1
+        )
+        # recovery lifts the quarantine
+        sick = cluster.get("Node", "s0-h0")
+        set_condition(sick, "TpuDegraded", "False")
+        cluster.update(sick)
+        assert mgr.reconcile() == set()
+        assert not get_annotation(cluster.get("Node", "s0-h0"), QKEY())
+        assert not get_annotation(cluster.get("Node", "s0-h1"), QKEY())
+
+    def test_reconcile_idempotent(self, cluster, recorder):
+        cluster.create(make_node("n1", labels={SLICE_KEY: "s0"}))
+        sick = cluster.get("Node", "n1")
+        set_condition(sick, "TpuDegraded", "True")
+        cluster.update(sick)
+        mgr = SliceHealthManager(cluster, recorder)
+        mgr.reconcile()
+        rv = cluster.get("Node", "n1")["metadata"]["resourceVersion"]
+        mgr.reconcile()  # no new writes when nothing changed
+        assert cluster.get("Node", "n1")["metadata"]["resourceVersion"] == rv
+
+
+class TestQuarantineAdmission:
+    def _fleet(self, cluster):
+        fleet = Fleet(cluster)
+        for s in range(2):
+            for h in range(2):
+                fleet.add_node(
+                    f"s{s}-h{h}", pod_hash="rev1", labels={SLICE_KEY: f"s{s}"}
+                )
+        fleet.publish_new_revision("rev2")
+        return fleet
+
+    def _policy(self, **kw):
+        return UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            slice_aware=True,
+            quarantine_degraded=True,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+            **kw,
+        )
+
+    def test_degraded_domain_not_admitted(self, cluster, fleet_unused=None):
+        fleet = self._fleet(cluster)
+        sick = cluster.get("Node", "s1-h0")
+        set_condition(sick, "TpuDegraded", "True")
+        cluster.update(sick)
+        manager = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        policy = self._policy()
+        for _ in range(3):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10)
+            manager.pod_manager.wait_idle(10)
+            fleet.reconcile_daemonset()
+        states = fleet.states()
+        # healthy s0 progressed; quarantined s1 never started
+        assert states["s1-h0"] == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        assert states["s1-h1"] == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        assert states["s0-h0"] != consts.UPGRADE_STATE_UPGRADE_REQUIRED
+
+    def test_recovered_domain_gets_admitted_and_converges(self, cluster):
+        fleet = self._fleet(cluster)
+        sick = cluster.get("Node", "s1-h0")
+        set_condition(sick, "TpuDegraded", "True")
+        cluster.update(sick)
+        manager = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        policy = self._policy()
+        for _ in range(2):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10)
+            manager.pod_manager.wait_idle(10)
+            fleet.reconcile_daemonset()
+        # repair the TPU → next reconciles admit s1 and finish
+        sick = cluster.get("Node", "s1-h0")
+        set_condition(sick, "TpuDegraded", "False")
+        cluster.update(sick)
+        for _ in range(30):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10)
+            manager.pod_manager.wait_idle(10)
+            fleet.reconcile_daemonset()
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+
+    def test_mid_upgrade_domain_finishes_despite_degradation(self, cluster):
+        """Quarantine bars STARTS only: a domain already mid-upgrade must
+        run to completion (half-upgraded + stranded is the worse state)."""
+        fleet = self._fleet(cluster)
+        manager = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        # admit everything first (no degradation yet): cycle 1 classifies
+        # into upgrade-required, cycle 2 admits (buckets fix at BuildState)
+        policy = self._policy()
+        for _ in range(2):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10)
+            manager.pod_manager.wait_idle(10)
+            fleet.reconcile_daemonset()
+        assert all(
+            s != consts.UPGRADE_STATE_UPGRADE_REQUIRED
+            for s in fleet.states().values()
+        )
+        # now a host degrades mid-flight
+        sick = cluster.get("Node", "s0-h0")
+        set_condition(sick, "TpuDegraded", "True")
+        cluster.update(sick)
+        for _ in range(30):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10)
+            manager.pod_manager.wait_idle(10)
+            fleet.reconcile_daemonset()
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+
+    def test_node_mode_quarantine(self, cluster):
+        fleet = Fleet(cluster)
+        fleet.add_node("bad", pod_hash="rev1")
+        fleet.add_node("good", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        sick = cluster.get("Node", "bad")
+        set_condition(sick, "TpuDegraded", "True")
+        cluster.update(sick)
+        manager = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            quarantine_degraded=True,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        for _ in range(10):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10)
+            manager.pod_manager.wait_idle(10)
+            fleet.reconcile_daemonset()
+            if fleet.states()["good"] == consts.UPGRADE_STATE_DONE:
+                break
+        states = fleet.states()
+        assert states["bad"] == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        assert states["good"] == consts.UPGRADE_STATE_DONE
+
+
+class TestStatusShowsDegraded:
+    def test_domain_degraded_flag(self, cluster):
+        fleet = Fleet(cluster)
+        fleet.add_node("s0-h0", labels={SLICE_KEY: "s0"})
+        sick = cluster.get("Node", "s0-h0")
+        set_condition(sick, "TpuDegraded", "True")
+        cluster.update(sick)
+        manager = ClusterUpgradeStateManager(cluster)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        status = RolloutStatus.from_cluster_state(state)
+        assert status.domains[0].degraded
+        assert status.to_dict()["domains"][0]["degraded"] is True
+
+
+class TestAnnotationQuarantineHonored:
+    def test_manual_annotation_bars_admission_without_live_signal(
+        self, cluster
+    ):
+        """The scheduler honors a stamped quarantine annotation even when
+        no live degradation condition is present (manual quarantine /
+        single-source-of-truth with SliceHealthManager)."""
+        fleet = Fleet(cluster)
+        fleet.add_node("s0-h0", pod_hash="rev1", labels={SLICE_KEY: "s0"})
+        fleet.publish_new_revision("rev2")
+        cluster.patch(
+            "Node",
+            "s0-h0",
+            {"metadata": {"annotations": {QKEY(): "s0"}}},
+        )
+        manager = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            slice_aware=True,
+            quarantine_degraded=True,
+        )
+        for _ in range(3):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+        assert (
+            fleet.states()["s0-h0"] == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        )
