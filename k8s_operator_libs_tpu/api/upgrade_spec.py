@@ -111,18 +111,26 @@ class DrainSpec:
     #: Seconds before giving up the drain; 0 = infinite (default 300).
     timeout_second: int = 300
     delete_empty_dir: bool = False
+    #: kubectl's --disable-eviction analog (extension; the reference spec
+    #: has no such field): bypass the Eviction API and thus
+    #: PodDisruptionBudgets.  Default False — drains evict and retry on
+    #: PDB 429s until the drain timeout.
+    disable_eviction: bool = False
 
     def validate(self) -> None:
         _require_non_negative("drain.timeoutSeconds", self.timeout_second)
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "enable": self.enable,
             "force": self.force,
             "podSelector": self.pod_selector,
             "timeoutSeconds": self.timeout_second,
             "deleteEmptyDir": self.delete_empty_dir,
         }
+        if self.disable_eviction:
+            out["disableEviction"] = True
+        return out
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "DrainSpec":
@@ -132,6 +140,7 @@ class DrainSpec:
             pod_selector=d.get("podSelector", ""),
             timeout_second=d.get("timeoutSeconds", 300),
             delete_empty_dir=d.get("deleteEmptyDir", False),
+            disable_eviction=d.get("disableEviction", False),
         )
 
 

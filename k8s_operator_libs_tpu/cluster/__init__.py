@@ -12,9 +12,11 @@ from .errors import (
     ConflictError,
     ExpiredError,
     NotFoundError,
+    TooManyRequestsError,
     is_already_exists,
     is_conflict,
     is_not_found,
+    is_too_many_requests,
 )
 from .inmem import InMemoryCluster, WatchEvent, merge_patch
 from .retry import retry_on_conflict
@@ -38,4 +40,6 @@ __all__ = [
     "is_not_found",
     "is_conflict",
     "is_already_exists",
+    "TooManyRequestsError",
+    "is_too_many_requests",
 ]

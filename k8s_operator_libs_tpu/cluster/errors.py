@@ -41,6 +41,14 @@ class ExpiredError(ApiError):
     code = 410
 
 
+class TooManyRequestsError(ApiError):
+    """Eviction blocked by a PodDisruptionBudget (the 429 the Eviction
+    subresource returns when disruptionsAllowed is 0) — the caller
+    retries, as kubectl drain does."""
+
+    code = 429
+
+
 def is_not_found(err: Exception) -> bool:
     """Reference: apierrors.IsNotFound."""
     return isinstance(err, NotFoundError)
@@ -53,3 +61,8 @@ def is_conflict(err: Exception) -> bool:
 
 def is_already_exists(err: Exception) -> bool:
     return isinstance(err, AlreadyExistsError)
+
+
+def is_too_many_requests(err: Exception) -> bool:
+    """The kubectl drain retry predicate for PDB-blocked evictions."""
+    return isinstance(err, TooManyRequestsError)

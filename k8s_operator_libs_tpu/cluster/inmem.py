@@ -42,6 +42,7 @@ from .errors import (
     ConflictError,
     ExpiredError,
     NotFoundError,
+    TooManyRequestsError,
 )
 from .selectors import parse_selector
 
@@ -364,6 +365,100 @@ class InMemoryCluster:
             self._store_pop(key)
             self._next_rv()  # deletions advance the version sequence too
             self._record("Deleted", json_copy(obj), None)
+
+    # ------------------------------------------------------------ eviction API
+    def evict(self, name: str, namespace: str = "") -> None:
+        """Eviction-subresource analog: delete the pod UNLESS a matching
+        PodDisruptionBudget has no disruptions left, in which case raise
+        :class:`TooManyRequestsError` (the 429 kubectl drain retries on).
+
+        Semantics mirror the real eviction registry:
+
+        * terminal pods (phase Succeeded/Failed) always evict — they
+          protect nothing;
+        * an UNHEALTHY pod evicts whenever the healthy count already
+          meets the requirement (removing it cannot reduce availability);
+        * a HEALTHY pod needs a positive disruption budget:
+          ``minAvailable`` ⇒ ``healthy - required > 0``;
+          ``maxUnavailable`` ⇒ ``max_unavailable - (expected - healthy)
+          > 0``; percentages resolve against the matching pod count with
+          round-up (GetScaledValueFromIntOrPercent, roundUp=true).
+
+        The budget check and the delete happen under ONE hold of the
+        store lock (it is re-entrant), so concurrent evictions cannot
+        jointly overdraw a budget."""
+        from ..api.intstr import IntOrString
+
+        def label_matches(match_labels, labels):
+            return all(labels.get(k) == v for k, v in match_labels.items())
+
+        with self._lock:
+            key = ("Pod", namespace, name)
+            pod = self._store.get(key)
+            if pod is None:
+                raise NotFoundError(f"Pod {namespace}/{name} not found")
+            phase = (pod.get("status") or {}).get("phase")
+            target_healthy = self._pod_healthy(pod)
+            pod_labels = (pod.get("metadata") or {}).get("labels") or {}
+            if phase not in ("Succeeded", "Failed"):
+                for pdb_key in self._by_kind.get("PodDisruptionBudget") or ():
+                    pdb = self._store.get(pdb_key)
+                    if pdb is None or pdb_key[1] != namespace:
+                        continue
+                    selector = (
+                        (pdb.get("spec") or {}).get("selector") or {}
+                    ).get("matchLabels") or {}
+                    if not label_matches(selector, pod_labels):
+                        continue
+                    matching = [
+                        self._store[k]
+                        for k in self._by_kind.get("Pod") or ()
+                        if k[1] == namespace
+                        and label_matches(
+                            selector,
+                            (self._store[k].get("metadata") or {}).get(
+                                "labels"
+                            )
+                            or {},
+                        )
+                    ]
+                    expected = len(matching)
+                    healthy = sum(
+                        1 for p in matching if self._pod_healthy(p)
+                    )
+                    spec = pdb.get("spec") or {}
+                    if spec.get("minAvailable") is not None:
+                        required = IntOrString.parse(
+                            spec["minAvailable"]
+                        ).scaled_value(expected, round_up=True)
+                    else:
+                        max_unavail = IntOrString.parse(
+                            spec.get("maxUnavailable", 0)
+                        ).scaled_value(expected, round_up=True)
+                        required = expected - max_unavail
+                    blocked = (
+                        healthy - required <= 0
+                        if target_healthy
+                        else healthy < required
+                    )
+                    if blocked:
+                        raise TooManyRequestsError(
+                            f"cannot evict Pod {namespace}/{name}: "
+                            f"disruption budget {pdb_key[2]} has no "
+                            f"disruptions allowed"
+                        )
+            # budget permits (or terminal / no PDB matched): graceful
+            # delete inside the same lock hold (RLock — re-entrant)
+            self.delete("Pod", name, namespace)
+
+    @staticmethod
+    def _pod_healthy(pod: JsonObj) -> bool:
+        if (pod.get("metadata") or {}).get("deletionTimestamp"):
+            return False
+        for cond in ((pod.get("status") or {}).get("conditions") or []):
+            if cond.get("type") == "Ready":
+                return cond.get("status") == "True"
+        return False
 
     # ------------------------------------------------------------- watch API
     def journal_seq(self) -> int:

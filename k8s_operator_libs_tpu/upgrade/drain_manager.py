@@ -30,7 +30,7 @@ from typing import Callable, List, Optional, Protocol, Tuple
 
 from .. import metrics
 from ..api.upgrade_spec import DrainSpec
-from ..cluster.errors import NotFoundError
+from ..cluster.errors import NotFoundError, TooManyRequestsError
 from ..cluster.inmem import InMemoryCluster, JsonObj
 from ..cluster.objects import (
     name_of,
@@ -70,6 +70,11 @@ class DrainHelperConfig:
     timeout_seconds: int = 300
     pod_selector: str = ""
     additional_filters: List[PodFilter] = field(default_factory=list)
+    #: kubectl's --disable-eviction: bypass the Eviction API (and thus
+    #: PodDisruptionBudgets) and delete directly.  Default False — like
+    #: kubectl, drains evict, and a PDB-blocked eviction (429) is retried
+    #: until the drain timeout.
+    disable_eviction: bool = False
 
 
 class DrainHelper:
@@ -139,19 +144,46 @@ class DrainHelper:
 
     # --------------------------------------------------------------- execute
     def delete_or_evict_pods(self, pods: List[JsonObj]) -> None:
-        """Delete every pod and wait (≤ timeout) until each is gone.  A pod
-        replaced by a new instance with the same name (different uid) counts
-        as gone."""
-        for pod in pods:
-            try:
-                self._cluster.delete("Pod", name_of(pod), namespace_of(pod))
-            except NotFoundError:
-                pass
+        """Evict (or, with ``disable_eviction``, delete) every pod and wait
+        (≤ timeout) until each is gone.  Eviction honors
+        PodDisruptionBudgets: a 429 is retried until the drain timeout,
+        mirroring kubectl's ``DeleteOrEvictPods`` wait loop.  A pod
+        replaced by a new instance with the same name (different uid)
+        counts as gone."""
         deadline = (
             time.monotonic() + self._config.timeout_seconds
             if self._config.timeout_seconds > 0
             else None
         )
+        to_evict = list(pods)
+        while to_evict:
+            blocked: List[JsonObj] = []
+            for pod in to_evict:
+                try:
+                    if self._config.disable_eviction:
+                        self._cluster.delete(
+                            "Pod", name_of(pod), namespace_of(pod)
+                        )
+                    else:
+                        self._cluster.evict(name_of(pod), namespace_of(pod))
+                except NotFoundError:
+                    pass
+                except TooManyRequestsError:
+                    blocked.append(pod)  # PDB budget exhausted — retry
+            if not blocked:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                raise DrainError(
+                    "drain timed out waiting for disruption budget: "
+                    + ", ".join(
+                        f"{namespace_of(p)}/{name_of(p)}" for p in blocked
+                    )
+                )
+            to_evict = blocked
+            # kubectl waits 5 s between eviction attempts; scaled down for
+            # the in-process substrate, but long enough that a PDB-wedged
+            # drain doesn't hammer the store lock every 10 ms
+            time.sleep(0.25)
         pending = {(namespace_of(p), name_of(p)): uid_of(p) for p in pods}
         while pending:
             for (ns, name), uid in list(pending.items()):
@@ -251,6 +283,7 @@ class DrainManager:
                     ignore_all_daemon_sets=True,
                     timeout_seconds=spec.timeout_second,
                     pod_selector=spec.pod_selector,
+                    disable_eviction=spec.disable_eviction,
                 ),
             )
             pods, errors = helper.get_pods_for_deletion(name)

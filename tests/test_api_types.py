@@ -95,3 +95,14 @@ class TestRoundTrip:
         p = UpgradePolicySpec.from_dict({})
         assert p.max_parallel_upgrades == 1
         assert p.max_unavailable == IntOrString("25%")
+
+
+def test_drain_spec_disable_eviction_round_trip():
+    from k8s_operator_libs_tpu.api import DrainSpec
+
+    spec = DrainSpec(enable=True, disable_eviction=True)
+    d = spec.to_dict()
+    assert d["disableEviction"] is True
+    assert DrainSpec.from_dict(d).disable_eviction is True
+    # default omits the key (reference-schema compatibility)
+    assert "disableEviction" not in DrainSpec(enable=True).to_dict()

@@ -656,3 +656,172 @@ class TestSafeDriverLoadManager:
         rv = cluster.get("Node", "n1")["metadata"]["resourceVersion"]
         SafeDriverLoadManager(provider).unblock_loading(node)
         assert cluster.get("Node", "n1")["metadata"]["resourceVersion"] == rv
+
+
+class TestPdbAwareEviction:
+    """Eviction-subresource semantics: PodDisruptionBudgets block drains
+    with 429 + retry, exactly the kubectl DeleteOrEvictPods contract the
+    reference inherits from k8s.io/kubectl/pkg/drain."""
+
+    RS = {"kind": "ReplicaSet", "metadata": {"name": "rs", "namespace": "ml"}}
+
+    def _pdb(self, cluster, min_available=None, max_unavailable=None):
+        spec = {"selector": {"matchLabels": {"job": "train"}}}
+        if min_available is not None:
+            spec["minAvailable"] = min_available
+        if max_unavailable is not None:
+            spec["maxUnavailable"] = max_unavailable
+        return cluster.create(
+            {
+                "kind": "PodDisruptionBudget",
+                "metadata": {"name": "pdb", "namespace": "ml"},
+                "spec": spec,
+            }
+        )
+
+    def test_min_available_blocks_then_allows(self, cluster):
+        from k8s_operator_libs_tpu.cluster.errors import (
+            TooManyRequestsError,
+            is_too_many_requests,
+        )
+
+        for i in range(2):
+            cluster.create(
+                make_pod(f"p{i}", "ml", f"n{i}", labels={"job": "train"})
+            )
+        self._pdb(cluster, min_available=2)
+        with pytest.raises(TooManyRequestsError) as exc:
+            cluster.evict("p0", "ml")
+        assert is_too_many_requests(exc.value)
+        assert cluster.exists("Pod", "p0", "ml")  # not deleted
+        cluster.create(make_pod("p2", "ml", "n2", labels={"job": "train"}))
+        cluster.evict("p0", "ml")  # budget now allows one disruption
+        assert not cluster.exists("Pod", "p0", "ml")
+
+    def test_max_unavailable_counts_unhealthy(self, cluster):
+        from k8s_operator_libs_tpu.cluster.errors import TooManyRequestsError
+
+        cluster.create(make_pod("p0", "ml", "n0", labels={"job": "train"}))
+        cluster.create(
+            make_pod("p1", "ml", "n1", labels={"job": "train"}, ready=False)
+        )
+        self._pdb(cluster, max_unavailable=1)
+        # one pod already unhealthy consumes the whole budget
+        with pytest.raises(TooManyRequestsError):
+            cluster.evict("p0", "ml")
+
+    def test_percent_min_available(self, cluster):
+        from k8s_operator_libs_tpu.cluster.errors import TooManyRequestsError
+
+        for i in range(4):
+            cluster.create(
+                make_pod(f"p{i}", "ml", f"n{i}", labels={"job": "train"})
+            )
+        self._pdb(cluster, min_available="75%")  # ceil(3) of 4 required
+        cluster.evict("p0", "ml")  # 4 healthy - 3 required = 1 allowed
+        with pytest.raises(TooManyRequestsError):
+            cluster.evict("p1", "ml")
+
+    def test_unmatched_pods_unaffected(self, cluster):
+        cluster.create(make_pod("other", "ml", "n0", labels={"job": "infer"}))
+        cluster.create(make_pod("p0", "ml", "n1", labels={"job": "train"}))
+        self._pdb(cluster, min_available=1)
+        cluster.evict("other", "ml")  # selector does not match → no PDB
+        assert not cluster.exists("Pod", "other", "ml")
+
+    def test_drain_helper_retries_429_until_budget_frees(
+        self, cluster, provider
+    ):
+        import threading
+        import time as _time
+
+        node = cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod("train-0", "ml", "n1", labels={"job": "train"}, owner=self.RS)
+        )
+        cluster.create(
+            make_pod("train-1", "ml", "n2", labels={"job": "train"}, owner=self.RS)
+        )
+        self._pdb(cluster, min_available=2)
+        helper = DrainHelper(
+            cluster,
+            DrainHelperConfig(force=True, timeout_seconds=5),
+        )
+        pods, errors = helper.get_pods_for_deletion("n1")
+        assert errors == [] and len(pods) == 1
+
+        def free_budget():
+            _time.sleep(0.15)
+            cluster.create(
+                make_pod(
+                    "train-2", "ml", "n3", labels={"job": "train"}, owner=self.RS
+                )
+            )
+
+        t = threading.Thread(target=free_budget)
+        t.start()
+        helper.delete_or_evict_pods(pods)  # blocks on 429 until the new pod
+        t.join()
+        assert not cluster.exists("Pod", "train-0", "ml")
+
+    def test_drain_helper_times_out_when_pdb_never_frees(
+        self, cluster, provider
+    ):
+        cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod("train-0", "ml", "n1", labels={"job": "train"}, owner=self.RS)
+        )
+        self._pdb(cluster, min_available=1)
+        helper = DrainHelper(
+            cluster, DrainHelperConfig(force=True, timeout_seconds=1)
+        )
+        pods, _ = helper.get_pods_for_deletion("n1")
+        with pytest.raises(DrainError, match="disruption budget"):
+            helper.delete_or_evict_pods(pods)
+        assert cluster.exists("Pod", "train-0", "ml")  # never deleted
+
+    def test_disable_eviction_bypasses_pdb(self, cluster, provider):
+        cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod("train-0", "ml", "n1", labels={"job": "train"}, owner=self.RS)
+        )
+        self._pdb(cluster, min_available=1)
+        helper = DrainHelper(
+            cluster,
+            DrainHelperConfig(
+                force=True, timeout_seconds=2, disable_eviction=True
+            ),
+        )
+        pods, _ = helper.get_pods_for_deletion("n1")
+        helper.delete_or_evict_pods(pods)
+        assert not cluster.exists("Pod", "train-0", "ml")
+
+    def test_terminal_pods_bypass_pdb(self, cluster):
+        """Succeeded/Failed pods protect nothing: real eviction always
+        permits them, exhausted budget or not."""
+        cluster.create(make_pod("p0", "ml", "n0", labels={"job": "train"}))
+        done = make_pod(
+            "p1", "ml", "n1", labels={"job": "train"},
+            phase="Succeeded", ready=False,
+        )
+        cluster.create(done)
+        self._pdb(cluster, min_available=2)  # budget exhausted (1 healthy)
+        cluster.evict("p1", "ml")  # terminal: evicts anyway
+        assert not cluster.exists("Pod", "p1", "ml")
+
+    def test_unhealthy_pod_evictable_when_requirement_met(self, cluster):
+        """An unhealthy pod's eviction cannot reduce availability — it is
+        allowed whenever healthy >= required, even with 0 budget left."""
+        from k8s_operator_libs_tpu.cluster.errors import TooManyRequestsError
+
+        cluster.create(make_pod("p0", "ml", "n0", labels={"job": "train"}))
+        cluster.create(
+            make_pod("p1", "ml", "n1", labels={"job": "train"}, ready=False)
+        )
+        self._pdb(cluster, min_available=1)
+        # healthy=1 == required=1: budget 0 for healthy pods...
+        with pytest.raises(TooManyRequestsError):
+            cluster.evict("p0", "ml")
+        # ...but the unhealthy one may still go
+        cluster.evict("p1", "ml")
+        assert not cluster.exists("Pod", "p1", "ml")

@@ -63,7 +63,7 @@ class CrashingCluster:
     crash would.  Background drain/eviction threads are exempt — they die
     with the old manager via ``wait_idle`` in the driver loop instead."""
 
-    _MUTATORS = frozenset({"create", "update", "patch", "delete"})
+    _MUTATORS = frozenset({"create", "update", "patch", "delete", "evict"})
 
     def __init__(self, inner: InMemoryCluster):
         self._inner = inner

@@ -1277,3 +1277,38 @@ class TestSliceCoherentSafeLoad:
         # not self-held: the unblock ran (annotation gone) so the node can
         # recover through the normal lifecycle
         assert not get_annotation(cluster.get("Node", "s0-h0"), safe_key)
+
+
+class TestPdbDrainIntegration:
+    def test_pdb_blocked_drain_fails_node(self, cluster, fleet):
+        """A workload pod protected by an exhausted PodDisruptionBudget
+        blocks the drain (eviction 429s) until the drain timeout; the
+        node then lands in upgrade-failed, like any drain failure."""
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        rs = {"kind": "ReplicaSet", "metadata": {"name": "rs", "namespace": "ml"}}
+        cluster.create(
+            make_pod("train", "ml", "n1", labels={"job": "train"}, owner=rs)
+        )
+        cluster.create(
+            {
+                "kind": "PodDisruptionBudget",
+                "metadata": {"name": "pdb", "namespace": "ml"},
+                "spec": {
+                    "selector": {"matchLabels": {"job": "train"}},
+                    "minAvailable": 1,
+                },
+            }
+        )
+        manager = make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=1),
+        )
+        for _ in range(8):
+            reconcile(manager, fleet, policy)
+            if fleet.node_state("n1") == consts.UPGRADE_STATE_FAILED:
+                break
+        assert fleet.node_state("n1") == consts.UPGRADE_STATE_FAILED
+        assert cluster.exists("Pod", "train", "ml")  # PDB held: never evicted
