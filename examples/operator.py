@@ -44,15 +44,39 @@ def main() -> int:
     manager = ClusterUpgradeStateManager(
         cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
     )
-    policy = UpgradePolicySpec(
-        auto_upgrade=True,
-        max_parallel_upgrades=0,
-        max_unavailable=IntOrString("34%"),  # 1 of 3 slices at a time
-        slice_aware=True,
-        drain_spec=DrainSpec(enable=True, force=True, timeout_second=60),
+    # The full CR-driven story: install the policy CRD (crdutil, the Helm
+    # pre-install hook pattern), create a TpuUpgradePolicy CR, and run the
+    # operator off it — editing the CR reconfigures the live rollout.
+    from k8s_operator_libs_tpu.controller import CrPolicySource
+    from k8s_operator_libs_tpu.crdutil import (
+        OPERATION_APPLY,
+        process_crds_with_config,
+        CRDProcessorConfig,
+    )
+
+    crd_path = os.path.join(
+        os.path.dirname(__file__), "..", "hack", "crd", "bases",
+        "tpu.google.com_tpuupgradepolicies.yaml",
+    )
+    process_crds_with_config(
+        cluster, CRDProcessorConfig(operation=OPERATION_APPLY, paths=[crd_path])
+    )
+    cluster.create(
+        {
+            "kind": "TpuUpgradePolicy",
+            "metadata": {"name": "fleet-policy", "namespace": NAMESPACE},
+            "spec": UpgradePolicySpec(
+                auto_upgrade=True,
+                max_parallel_upgrades=0,
+                max_unavailable=IntOrString("34%"),  # 1 of 3 slices at a time
+                slice_aware=True,
+                drain_spec=DrainSpec(enable=True, force=True, timeout_second=60),
+            ).to_dict(),
+        }
     )
     controller = new_upgrade_controller(
-        cluster, manager, NAMESPACE, DRIVER_LABELS, policy,
+        cluster, manager, NAMESPACE, DRIVER_LABELS,
+        policy_source=CrPolicySource(cluster, "fleet-policy", NAMESPACE),
         resync_seconds=0.25, active_requeue_seconds=0.02,
     )
 

@@ -33,6 +33,18 @@ def _require_non_negative(name: str, value: int) -> None:
         raise ValidationError(f"{name} must be >= 0, got {value}")
 
 
+def _require_bool(name: str, value) -> None:
+    """Boolean fields must be real booleans: a CR edit like
+    ``autoUpgrade: "false"`` is truthy as a string, and silently
+    accepting it inverts the operator's intent (the in-process store does
+    not enforce the CRD openAPI schema, so validate() is the only
+    gate)."""
+    if not isinstance(value, bool):
+        raise ValidationError(
+            f"{name} must be a boolean, got {type(value).__name__} {value!r}"
+        )
+
+
 @dataclass
 class WaitForCompletionSpec:
     """Wait for consumer jobs to finish before upgrading a node.
@@ -78,6 +90,8 @@ class PodDeletionSpec:
 
     def validate(self) -> None:
         _require_non_negative("podDeletion.timeoutSeconds", self.timeout_second)
+        _require_bool("podDeletion.force", self.force)
+        _require_bool("podDeletion.deleteEmptyDir", self.delete_empty_dir)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -119,6 +133,10 @@ class DrainSpec:
 
     def validate(self) -> None:
         _require_non_negative("drain.timeoutSeconds", self.timeout_second)
+        _require_bool("drain.enable", self.enable)
+        _require_bool("drain.force", self.force)
+        _require_bool("drain.deleteEmptyDir", self.delete_empty_dir)
+        _require_bool("drain.disableEviction", self.disable_eviction)
 
     def to_dict(self) -> Dict[str, Any]:
         out = {
@@ -164,6 +182,7 @@ class PreDrainCheckpointSpec:
         _require_non_negative(
             "preDrainCheckpoint.timeoutSeconds", self.timeout_second
         )
+        _require_bool("preDrainCheckpoint.enable", self.enable)
 
     def to_dict(self) -> Dict[str, Any]:
         return {"enable": self.enable, "timeoutSeconds": self.timeout_second}
@@ -210,6 +229,9 @@ class UpgradePolicySpec:
             self.max_unavailable = IntOrString(self.max_unavailable)
 
     def validate(self) -> None:
+        _require_bool("autoUpgrade", self.auto_upgrade)
+        _require_bool("sliceAware", self.slice_aware)
+        _require_bool("quarantineDegraded", self.quarantine_degraded)
         _require_non_negative("maxParallelUpgrades", self.max_parallel_upgrades)
         for sub in (
             self.pod_deletion,

@@ -9,7 +9,9 @@ from .controller import (
 )
 from .leader_election import LeaderElector
 from .upgrade_reconciler import (
+    POLICY_KIND,
     UPGRADE_REQUEST,
+    CrPolicySource,
     UpgradeReconciler,
     new_upgrade_controller,
 )
@@ -27,6 +29,8 @@ __all__ = [
     "Request",
     "Result",
     "UPGRADE_REQUEST",
+    "POLICY_KIND",
+    "CrPolicySource",
     "UpgradeReconciler",
     "new_upgrade_controller",
     "ExponentialBackoffRateLimiter",

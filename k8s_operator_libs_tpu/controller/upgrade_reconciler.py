@@ -17,10 +17,11 @@ SURVEY.md §3.2) and goes quiet when the fleet is steady.
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, Hashable, Iterable, Optional
 
-from ..api.upgrade_spec import UpgradePolicySpec
+from ..api.upgrade_spec import UpgradePolicySpec, ValidationError
+from ..cluster.errors import NotFoundError
 from ..cluster.inmem import InMemoryCluster, JsonObj
 from ..upgrade.upgrade_state import ClusterUpgradeStateManager
 from .controller import Controller, Result
@@ -30,19 +31,73 @@ logger = logging.getLogger(__name__)
 #: The one request every event maps to.
 UPGRADE_REQUEST = "upgrade-cycle"
 
+#: Kind of the policy custom resource (CRD at
+#: hack/crd/bases/tpu.google.com_tpuupgradepolicies.yaml).
+POLICY_KIND = "TpuUpgradePolicy"
+
 
 def _singleton_mapper(_obj: JsonObj) -> Iterable[Hashable]:
     return [UPGRADE_REQUEST]
 
 
 @dataclass
+class CrPolicySource:
+    """Live upgrade policy read from a TpuUpgradePolicy custom resource.
+
+    The reference ships its policy as a CRD *fragment* consumers embed in
+    their own CRDs (DriverUpgradePolicySpec, upgrade_spec.go:27-49) and
+    re-read every reconcile; this is the standalone equivalent — edit the
+    CR and the running operator picks the change up on its next pass (the
+    controller also watches the kind, so an edit wakes it immediately).
+
+    Failure behavior: a missing CR **pauses** the rollout (``current()``
+    returns None and the reconciler treats it as auto_upgrade=False —
+    deleting the policy is the emergency stop); an *invalid* CR keeps the
+    **last good** policy and logs, so a bad edit cannot yank throttling
+    mid-rollout."""
+
+    cluster: InMemoryCluster
+    name: str
+    namespace: str = ""
+    _last_good: Optional[UpgradePolicySpec] = field(
+        default=None, init=False, repr=False
+    )
+
+    def current(self) -> Optional[UpgradePolicySpec]:
+        try:
+            obj = self.cluster.get(POLICY_KIND, self.name, self.namespace)
+        except NotFoundError:
+            self._last_good = None
+            return None
+        try:
+            policy = UpgradePolicySpec.from_dict(obj.get("spec") or {})
+            policy.validate()
+        except (ValidationError, ValueError, TypeError) as err:
+            logger.warning(
+                "TpuUpgradePolicy %s/%s invalid (%s); keeping last good "
+                "policy",
+                self.namespace,
+                self.name,
+                err,
+            )
+            return self._last_good
+        self._last_good = policy
+        return policy
+
+
+@dataclass
 class UpgradeReconciler:
-    """Runs one BuildState/ApplyState pass per request."""
+    """Runs one BuildState/ApplyState pass per request.
+
+    The policy is either a fixed :class:`UpgradePolicySpec` (``policy``)
+    or a live source (``policy_source``, e.g. :class:`CrPolicySource`) —
+    the source is re-read every pass, so policy edits apply mid-rollout."""
 
     manager: ClusterUpgradeStateManager
     namespace: str
     driver_labels: Dict[str, str]
-    policy: UpgradePolicySpec
+    policy: Optional[UpgradePolicySpec] = None
+    policy_source: Optional[object] = None
     #: requeue delay while a rollout is in flight (async workers report
     #: through node labels; this is the pickup latency)
     active_requeue_seconds: float = 0.05
@@ -52,9 +107,21 @@ class UpgradeReconciler:
     #: forever; a watch event on the fix wakes us sooner anyway
     failed_requeue_seconds: float = 5.0
 
+    def _current_policy(self) -> Optional[UpgradePolicySpec]:
+        if self.policy_source is not None:
+            return self.policy_source.current()
+        return self.policy
+
     def reconcile(self, request: Hashable) -> Optional[Result]:
         state = self.manager.build_state(self.namespace, self.driver_labels)
-        self.manager.apply_state(state, self.policy)
+        policy = self._current_policy()
+        if policy is None:
+            # no (or deleted) policy CR: the rollout is paused — publish
+            # gauges from the fresh snapshot and go quiet until a policy
+            # event wakes us
+            self.manager.apply_state(state, None)
+            return None
+        self.manager.apply_state(state, policy)
         common = self.manager.common
         if common.get_upgrades_in_progress(state) or common.get_upgrades_pending(
             state
@@ -70,8 +137,9 @@ def new_upgrade_controller(
     manager: ClusterUpgradeStateManager,
     namespace: str,
     driver_labels: Dict[str, str],
-    policy: UpgradePolicySpec,
+    policy: Optional[UpgradePolicySpec] = None,
     *,
+    policy_source: Optional[object] = None,
     extra_kinds: Iterable[str] = (),
     resync_seconds: float = 1.0,
     active_requeue_seconds: float = 0.05,
@@ -80,12 +148,27 @@ def new_upgrade_controller(
 ) -> Controller:
     """Assemble the standard operator: watches on Nodes, driver Pods,
     DaemonSets (and NodeMaintenance when requestor mode needs it via
-    *extra_kinds*), all funneled into the singleton upgrade request."""
+    *extra_kinds*), all funneled into the singleton upgrade request.
+
+    Pass either a fixed *policy* or a live *policy_source* (e.g.
+    :class:`CrPolicySource`); with a source, the policy kind is watched
+    too, so CR edits wake the operator immediately."""
+    if (policy is None) == (policy_source is None):
+        raise ValueError("pass exactly one of policy / policy_source")
+    if policy_source is not None and not callable(
+        getattr(policy_source, "current", None)
+    ):
+        # fail at assembly, not as an AttributeError hot-loop inside the
+        # worker thread's per-item retry
+        raise TypeError(
+            "policy_source must provide current() -> Optional[UpgradePolicySpec]"
+        )
     reconciler = UpgradeReconciler(
         manager=manager,
         namespace=namespace,
         driver_labels=driver_labels,
         policy=policy,
+        policy_source=policy_source,
         active_requeue_seconds=active_requeue_seconds,
         failed_requeue_seconds=failed_requeue_seconds,
     )
@@ -96,6 +179,9 @@ def new_upgrade_controller(
         resync_seconds=resync_seconds,
         watch_poll_seconds=watch_poll_seconds,
     )
-    for kind in ("Node", "Pod", "DaemonSet", *extra_kinds):
+    kinds = ["Node", "Pod", "DaemonSet", *extra_kinds]
+    if policy_source is not None:
+        kinds.append(POLICY_KIND)
+    for kind in kinds:
         controller.watches(kind, mapper=_singleton_mapper)
     return controller

@@ -454,3 +454,187 @@ class TestUpgradeOperator:
             assert len(rec_calls) == settled
         finally:
             ctrl.stop()
+
+
+class TestCrDrivenPolicy:
+    """The operator driven entirely by a TpuUpgradePolicy CR: edits apply
+    live, deletion pauses, invalid specs keep the last good policy."""
+
+    POLICY = {
+        "kind": "TpuUpgradePolicy",
+        "metadata": {"name": "fleet-policy", "namespace": NAMESPACE},
+        "spec": {
+            "autoUpgrade": False,
+            "maxParallelUpgrades": 0,
+            "maxUnavailable": "100%",
+            "drain": {"enable": True, "force": True, "timeoutSeconds": 10},
+        },
+    }
+
+    def _boot(self, cluster):
+        from k8s_operator_libs_tpu.controller import (
+            CrPolicySource,
+            new_upgrade_controller,
+        )
+
+        manager = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        source = CrPolicySource(cluster, "fleet-policy", NAMESPACE)
+        ctrl = new_upgrade_controller(
+            cluster,
+            manager,
+            NAMESPACE,
+            DRIVER_LABELS,
+            policy_source=source,
+            resync_seconds=0.1,
+            active_requeue_seconds=0.02,
+        )
+        return ctrl, source
+
+    def test_cr_enable_starts_and_edit_applies_live(self, cluster):
+        import copy as _copy
+
+        fleet = Fleet(cluster)
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        cluster.create(_copy.deepcopy(self.POLICY))
+        ctrl, _ = self._boot(cluster)
+        with daemonset_loop(fleet):
+            ctrl.start()
+            try:
+                time.sleep(0.3)  # paused: autoUpgrade=False
+                assert fleet.node_state("n1") in (
+                    "",
+                    consts.UPGRADE_STATE_DONE,
+                )
+                # flip the switch on the live CR
+                cluster.patch(
+                    "TpuUpgradePolicy",
+                    "fleet-policy",
+                    {"spec": {"autoUpgrade": True}},
+                    NAMESPACE,
+                )
+                assert wait_for_converged(fleet, timeout=20.0), fleet.states()
+            finally:
+                ctrl.stop()
+
+    def test_cr_deleted_mid_rollout_pauses(self, cluster):
+        import copy as _copy
+
+        fleet = Fleet(cluster)
+        for i in range(4):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        spec = _copy.deepcopy(self.POLICY)
+        spec["spec"]["autoUpgrade"] = True
+        # serialize: one node at a time so there is a mid-rollout window
+        spec["spec"]["maxParallelUpgrades"] = 1
+        spec["spec"]["maxUnavailable"] = 1
+        cluster.create(spec)
+        ctrl, _ = self._boot(cluster)
+        with daemonset_loop(fleet):
+            ctrl.start()
+            try:
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    done = [
+                        s
+                        for s in fleet.states().values()
+                        if s == consts.UPGRADE_STATE_DONE
+                    ]
+                    if 0 < len(done) < 4:
+                        break
+                    time.sleep(0.01)
+                else:
+                    pytest.fail("never observed a mid-rollout window")
+                cluster.delete("TpuUpgradePolicy", "fleet-policy", NAMESPACE)
+                time.sleep(0.5)  # the operator processes the deletion
+                snapshot = fleet.states()
+                time.sleep(0.5)
+                # paused: no further progress after the settle window
+                later = fleet.states()
+                new_done = sum(
+                    1
+                    for n, s in later.items()
+                    if s == consts.UPGRADE_STATE_DONE
+                    and snapshot[n] != consts.UPGRADE_STATE_DONE
+                )
+                # nothing NEW reaches done after the pause settled, and
+                # un-admitted nodes stay put
+                assert new_done == 0, (snapshot, later)
+                assert any(
+                    s == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+                    for s in later.values()
+                ), later
+            finally:
+                ctrl.stop()
+
+    def test_invalid_edit_keeps_last_good(self, cluster):
+        import copy as _copy
+
+        from k8s_operator_libs_tpu.controller import CrPolicySource
+
+        spec = _copy.deepcopy(self.POLICY)
+        spec["spec"]["autoUpgrade"] = True
+        cluster.create(spec)
+        source = CrPolicySource(cluster, "fleet-policy", NAMESPACE)
+        good = source.current()
+        assert good is not None and good.auto_upgrade
+        cluster.patch(
+            "TpuUpgradePolicy",
+            "fleet-policy",
+            {"spec": {"maxParallelUpgrades": -5}},
+            NAMESPACE,
+        )
+        kept = source.current()
+        assert kept is good  # invalid edit → last good retained
+        assert kept.max_parallel_upgrades == 0
+
+    def test_missing_cr_is_paused(self, cluster):
+        from k8s_operator_libs_tpu.controller import CrPolicySource
+
+        source = CrPolicySource(cluster, "absent", NAMESPACE)
+        assert source.current() is None
+
+    def test_policy_xor_source_enforced(self, cluster):
+        from k8s_operator_libs_tpu.controller import new_upgrade_controller
+
+        manager = ClusterUpgradeStateManager(cluster)
+        with pytest.raises(ValueError, match="exactly one"):
+            new_upgrade_controller(
+                cluster, manager, NAMESPACE, DRIVER_LABELS
+            )
+
+    def test_string_boolean_edit_rejected(self, cluster):
+        """Regression: `autoUpgrade: "false"` (string, truthy) must be
+        rejected by validate(), not accepted as an enabled policy."""
+        import copy as _copy
+
+        from k8s_operator_libs_tpu.controller import CrPolicySource
+
+        spec = _copy.deepcopy(self.POLICY)
+        spec["spec"]["autoUpgrade"] = True
+        cluster.create(spec)
+        source = CrPolicySource(cluster, "fleet-policy", NAMESPACE)
+        good = source.current()
+        cluster.patch(
+            "TpuUpgradePolicy",
+            "fleet-policy",
+            {"spec": {"autoUpgrade": "false"}},
+            NAMESPACE,
+        )
+        assert source.current() is good  # invalid type → last good kept
+
+    def test_bad_policy_source_fails_at_assembly(self, cluster):
+        from k8s_operator_libs_tpu.controller import new_upgrade_controller
+
+        manager = ClusterUpgradeStateManager(cluster)
+        with pytest.raises(TypeError, match="current"):
+            new_upgrade_controller(
+                cluster,
+                manager,
+                NAMESPACE,
+                DRIVER_LABELS,
+                policy_source=UpgradePolicySpec(auto_upgrade=True),
+            )
