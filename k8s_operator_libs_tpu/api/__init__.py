@@ -2,6 +2,7 @@
 
 from .intstr import IntOrString
 from .upgrade_spec import (
+    MaintenanceWindowSpec,
     DrainSpec,
     PodDeletionSpec,
     PreDrainCheckpointSpec,
@@ -11,6 +12,7 @@ from .upgrade_spec import (
 )
 
 __all__ = [
+    "MaintenanceWindowSpec",
     "IntOrString",
     "DrainSpec",
     "PodDeletionSpec",

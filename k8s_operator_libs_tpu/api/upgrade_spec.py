@@ -163,6 +163,67 @@ class DrainSpec:
 
 
 @dataclass
+class MaintenanceWindowSpec:
+    """Recurring UTC window inside which NEW upgrades may start
+    (extension; the reference has no schedule gating).  Mid-flight nodes
+    finish outside the window."""
+
+    #: Window start, "HH:MM" UTC.
+    start: str = "00:00"
+    #: Window length in minutes (may cross midnight).
+    duration_minutes: int = 1440
+    #: Days ("Mon".."Sun") the window STARTS on; empty = every day.
+    days: tuple = ()
+
+    _DAY_NAMES = ("Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun")
+
+    def parsed_start(self) -> tuple:
+        try:
+            hour_s, minute_s = self.start.split(":")
+            hour, minute = int(hour_s), int(minute_s)
+        except (ValueError, AttributeError) as err:
+            raise ValidationError(
+                f"maintenanceWindow.start must be 'HH:MM', got {self.start!r}"
+            ) from err
+        if not (0 <= hour <= 23 and 0 <= minute <= 59):
+            raise ValidationError(
+                f"maintenanceWindow.start out of range: {self.start!r}"
+            )
+        return hour, minute
+
+    def validate(self) -> None:
+        self.parsed_start()
+        if self.duration_minutes <= 0:
+            raise ValidationError(
+                "maintenanceWindow.durationMinutes must be > 0, got "
+                f"{self.duration_minutes}"
+            )
+        for day in self.days:
+            if day not in self._DAY_NAMES:
+                raise ValidationError(
+                    f"maintenanceWindow.days entry {day!r} not one of "
+                    f"{self._DAY_NAMES}"
+                )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "start": self.start,
+            "durationMinutes": self.duration_minutes,
+        }
+        if self.days:
+            out["days"] = list(self.days)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "MaintenanceWindowSpec":
+        return cls(
+            start=d.get("start", "00:00"),
+            duration_minutes=d.get("durationMinutes", 1440),
+            days=tuple(d.get("days") or ()),
+        )
+
+
+@dataclass
 class PreDrainCheckpointSpec:
     """TPU-native: gate drain on a checkpoint-saved handshake.
 
@@ -223,6 +284,10 @@ class UpgradePolicySpec:
     #: Refuse to START upgrading a domain with a degraded TPU host (see
     #: tpu.health); domains already mid-upgrade finish.
     quarantine_degraded: bool = False
+    #: NEW upgrades start only inside this recurring UTC window.
+    maintenance_window: Optional[MaintenanceWindowSpec] = None
+    #: At most this many node admissions per trailing hour; 0 = unlimited.
+    max_nodes_per_hour: int = 0
 
     def __post_init__(self) -> None:
         if isinstance(self.max_unavailable, (int, str)):
@@ -233,6 +298,9 @@ class UpgradePolicySpec:
         _require_bool("sliceAware", self.slice_aware)
         _require_bool("quarantineDegraded", self.quarantine_degraded)
         _require_non_negative("maxParallelUpgrades", self.max_parallel_upgrades)
+        _require_non_negative("maxNodesPerHour", self.max_nodes_per_hour)
+        if self.maintenance_window is not None:
+            self.maintenance_window.validate()
         for sub in (
             self.pod_deletion,
             self.wait_for_completion,
@@ -264,6 +332,10 @@ class UpgradePolicySpec:
             out["preDrainCheckpoint"] = self.pre_drain_checkpoint.to_dict()
         if self.quarantine_degraded:
             out["quarantineDegraded"] = True
+        if self.maintenance_window is not None:
+            out["maintenanceWindow"] = self.maintenance_window.to_dict()
+        if self.max_nodes_per_hour:
+            out["maxNodesPerHour"] = self.max_nodes_per_hour
         return out
 
     @classmethod
@@ -295,4 +367,10 @@ class UpgradePolicySpec:
                 else None
             ),
             quarantine_degraded=d.get("quarantineDegraded", False),
+            maintenance_window=(
+                MaintenanceWindowSpec.from_dict(d["maintenanceWindow"])
+                if d.get("maintenanceWindow") is not None
+                else None
+            ),
+            max_nodes_per_hour=d.get("maxNodesPerHour", 0),
         )

@@ -128,6 +128,10 @@ UPGRADE_VALIDATION_START_TIME_ANNOTATION_KEY_FMT = (
     DOMAIN + "/%s-upgrade.validation-start-time"
 )
 
+#: Node annotation stamping when the node was admitted to upgrade
+#: (drives the max-nodes-per-hour pacing gate; see upgrade/schedule.py).
+UPGRADE_ADMITTED_AT_ANNOTATION_KEY_FMT = DOMAIN + "/%s-upgrade.admitted-at"
+
 #: TPU-native: node annotation marking the host's slice domain as
 #: quarantined because a domain member has a degraded TPU (value = the
 #: domain id); maintained by tpu.health.SliceHealthManager.

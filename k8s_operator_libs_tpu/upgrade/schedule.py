@@ -1,0 +1,111 @@
+"""Maintenance windows + admission pacing — WHEN upgrades may start.
+
+The reference throttle bounds *how many* nodes upgrade concurrently
+(maxParallelUpgrades / maxUnavailable); fleet operations also need to
+bound *when* and *how fast*:
+
+* **maintenance window** — new upgrades start only inside a recurring
+  UTC window (e.g. 22:00 + 240 minutes on weekdays).  Nodes already
+  mid-upgrade finish outside the window (stranding a half-upgraded
+  slice is worse than overrunning the window — same principle as the
+  degraded-domain quarantine).
+* **pacing** — at most N node admissions per trailing hour, recorded
+  via an ``…upgrade.admitted-at`` timestamp annotation stamped at
+  admission.  Because the record lives on the node (like all state in
+  this library), pacing survives operator restarts and HA failovers.
+
+Both are pure schedule *gates* composed with the existing slot math:
+a closed window zeroes the slot budget; pacing caps how many of the
+available slots may be spent this pass.  Throttle bypasses (the
+already-active-domain straggler rule, manually cordoned nodes) are
+unaffected — those nodes' domains are already disrupted.
+"""
+
+from __future__ import annotations
+
+import math
+import time as _time
+from datetime import datetime, time as dtime, timedelta, timezone
+from typing import Iterable, Optional
+
+from ..api.upgrade_spec import MaintenanceWindowSpec
+from ..cluster.inmem import JsonObj
+from . import util
+
+#: Trailing window for admission pacing (seconds).
+PACING_WINDOW_SECONDS = 3600.0
+
+#: Single source of truth for day names (validation in the spec and
+#: evaluation here must never diverge).
+_DAY_NAMES = MaintenanceWindowSpec._DAY_NAMES
+
+
+def _now_utc() -> datetime:
+    """Module hook so tests can pin the clock."""
+    return datetime.now(timezone.utc)
+
+
+def window_open(spec, now: Optional[datetime] = None) -> bool:
+    """True when *now* (UTC) falls inside the recurring window.
+
+    The window may cross midnight; the ``days`` filter applies to the
+    day the window *started* (a Friday 22:00 + 6h window still covers
+    Saturday 03:00)."""
+    if now is None:
+        now = _now_utc()
+    hour, minute = spec.parsed_start()
+    # A window lasting D days can have started up to D days ago — check
+    # every candidate start day, not just today/yesterday (a 3-day
+    # weekend window is still open on Monday).
+    max_back = math.ceil(spec.duration_minutes / 1440)
+    for day_offset in range(0, -(max_back + 1), -1):
+        day = now.date() + timedelta(days=day_offset)
+        start = datetime.combine(
+            day, dtime(hour, minute), tzinfo=timezone.utc
+        )
+        end = start + timedelta(minutes=spec.duration_minutes)
+        if start <= now < end:
+            if not spec.days or _DAY_NAMES[day.weekday()] in spec.days:
+                return True
+    return False
+
+
+def count_recent_admissions(
+    nodes: Iterable[JsonObj],
+    now_ts: Optional[float] = None,
+    window_seconds: float = PACING_WINDOW_SECONDS,
+) -> int:
+    """Nodes whose admitted-at stamp lies inside the trailing window."""
+    if now_ts is None:
+        now_ts = _time.time()
+    key = util.get_admitted_at_annotation_key()
+    count = 0
+    for node in nodes:
+        raw = ((node.get("metadata") or {}).get("annotations") or {}).get(key)
+        if not raw:
+            continue
+        try:
+            ts = float(raw)
+        except ValueError:
+            continue
+        if now_ts - ts < window_seconds:
+            count += 1
+    return count
+
+
+def stamp_admission(provider, node: JsonObj, now_ts: Optional[float] = None) -> None:
+    """Record the admission time on the node (pacing survives restarts)."""
+    if now_ts is None:
+        now_ts = _time.time()
+    provider.change_node_upgrade_annotation(
+        node, util.get_admitted_at_annotation_key(), repr(now_ts)
+    )
+
+
+def pacing_budget(policy, state_nodes: Iterable[JsonObj]) -> Optional[int]:
+    """Remaining node admissions this trailing hour, or None when pacing
+    is off."""
+    limit = getattr(policy, "max_nodes_per_hour", 0) or 0
+    if limit <= 0:
+        return None
+    return max(0, limit - count_recent_admissions(state_nodes))

@@ -26,7 +26,7 @@ from typing import List
 
 from ..api.upgrade_spec import UpgradePolicySpec
 from ..tpu import topology
-from . import consts, util
+from . import consts, schedule, util
 from .common_manager import ClusterUpgradeState, CommonUpgradeManager, NodeUpgradeState
 
 logger = logging.getLogger(__name__)
@@ -69,12 +69,32 @@ class InplaceNodeStateManager:
             slice_aware,
         )
 
+        # Schedule gates (upgrade/schedule.py): a closed maintenance
+        # window zeroes the slot budget (bypasses — already-active-domain
+        # stragglers, manually cordoned nodes — still finish); pacing caps
+        # how many node admissions the trailing hour may add.
+        if policy.maintenance_window is not None and not schedule.window_open(
+            policy.maintenance_window
+        ):
+            logger.info("outside maintenance window; no new admissions")
+            available = 0
+        pacing = schedule.pacing_budget(
+            policy, (ns.node for ns in state.all_node_states())
+        )
+
         node_states = state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED)
         quarantined = self._quarantined_domains(state, policy)
         if slice_aware:
-            self._schedule_by_domain(state, node_states, available, quarantined)
+            self._schedule_by_domain(
+                state,
+                node_states,
+                available,
+                quarantined,
+                pacing,
+                pacing_limit=policy.max_nodes_per_hour,
+            )
         else:
-            self._schedule_by_node(node_states, available, quarantined)
+            self._schedule_by_node(node_states, available, quarantined, pacing)
 
     def _quarantined_domains(
         self, state: ClusterUpgradeState, policy: UpgradePolicySpec
@@ -124,6 +144,7 @@ class InplaceNodeStateManager:
         node_states: List[NodeUpgradeState],
         available: int,
         quarantined=None,
+        pacing=None,
     ) -> None:
         common = self._common
         for node_state in node_states:
@@ -136,13 +157,26 @@ class InplaceNodeStateManager:
                     (node.get("metadata") or {}).get("name", ""),
                 )
                 continue
-            if available <= 0 and not common.is_node_unschedulable(node):
-                # Limit reached; only manually-cordoned nodes may proceed
-                # (reference :87-97).
-                continue
+            bypass = common.is_node_unschedulable(node)
+            if not bypass:
+                if available <= 0:
+                    # Limit reached; only manually-cordoned nodes may
+                    # proceed (reference :87-97).
+                    continue
+                if pacing is not None and pacing <= 0:
+                    continue  # hourly pacing budget spent
             common.provider.change_node_upgrade_state(
                 node, consts.UPGRADE_STATE_CORDON_REQUIRED
             )
+            # bypass admissions (already cordoned) are continuations of an
+            # existing disruption: exempt from pacing — not stamped, not
+            # decremented — so they cannot starve later hours' budgets.
+            # The SLOT budget still decrements unconditionally (reference
+            # behavior, :87-97).
+            if not bypass:
+                schedule.stamp_admission(common.provider, node)
+                if pacing is not None:
+                    pacing -= 1
             available -= 1
 
     def _schedule_by_domain(
@@ -151,6 +185,8 @@ class InplaceNodeStateManager:
         node_states: List[NodeUpgradeState],
         available: int,
         quarantined=None,
+        pacing=None,
+        pacing_limit: int = 0,
     ) -> None:
         """Slice-aware scheduling: one slot = one domain; all of a chosen
         domain's upgrade-required nodes advance together.
@@ -187,14 +223,37 @@ class InplaceNodeStateManager:
                     domain,
                 )
                 continue
-            if available <= 0 and not bypass:
-                continue
+            if not bypass:
+                if available <= 0:
+                    continue
+                # pacing counts NODES: the whole domain co-schedules, so
+                # it must fit in the remaining hourly budget (stragglers
+                # of active domains are exempt — their slice is already
+                # down)
+                if pacing is not None and len(nodes) > pacing:
+                    if pacing_limit and len(nodes) > pacing_limit:
+                        # no trailing hour can EVER fit this domain: the
+                        # policy is unsatisfiable for it — surface loudly
+                        # instead of deferring in silence forever
+                        logger.warning(
+                            "domain %s has %d nodes but maxNodesPerHour=%d "
+                            "— it can never be admitted; raise the limit "
+                            "or exempt the domain",
+                            domain,
+                            len(nodes),
+                            pacing_limit,
+                        )
+                    continue
             for node in nodes:
                 common.provider.change_node_upgrade_state(
                     node, consts.UPGRADE_STATE_CORDON_REQUIRED
                 )
+                if not bypass:
+                    schedule.stamp_admission(common.provider, node)
             if not bypass:
                 available -= 1
+                if pacing is not None:
+                    pacing -= len(nodes)
 
     # ------------------------------------------------- node-maintenance (n/a)
     def process_node_maintenance_required_nodes(

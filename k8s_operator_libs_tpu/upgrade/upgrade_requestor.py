@@ -36,7 +36,7 @@ from ..api.upgrade_spec import UpgradePolicySpec
 from ..cluster.errors import AlreadyExistsError, NotFoundError
 from ..cluster.inmem import InMemoryCluster, JsonObj, WatchEvent
 from ..cluster.objects import name_of
-from . import consts, util
+from . import consts, schedule, util
 from .common_manager import ClusterUpgradeState, CommonUpgradeManager, NodeUpgradeState
 
 logger = logging.getLogger(__name__)
@@ -315,9 +315,23 @@ class RequestorNodeStateManager:
     def process_upgrade_required_nodes(
         self, state: ClusterUpgradeState, policy: UpgradePolicySpec
     ) -> None:
-        """Reference: ProcessUpgradeRequiredNodes (:277-319)."""
+        """Reference: ProcessUpgradeRequiredNodes (:277-319).
+
+        Schedule gates apply before the maintenance handoff too: outside
+        the maintenance window no NEW NodeMaintenance CRs are created
+        (nodes already handed off continue), and hourly pacing caps how
+        many nodes may be handed off per pass (upgrade/schedule.py)."""
         common = self._common
         self.set_default_node_maintenance(policy)
+        if (
+            policy.maintenance_window is not None
+            and not schedule.window_open(policy.maintenance_window)
+        ):
+            logger.info("outside maintenance window; no new maintenance handoffs")
+            return
+        pacing = schedule.pacing_budget(
+            policy, (ns.node for ns in state.all_node_states())
+        )
         for node_state in state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED):
             node = node_state.node
             if common.is_upgrade_requested(node):
@@ -329,7 +343,14 @@ class RequestorNodeStateManager:
             if common.skip_node_upgrade(node):
                 logger.info("node %s is marked to skip upgrades", name_of(node))
                 continue
+            if pacing is not None:
+                if pacing <= 0:
+                    continue  # hourly pacing budget spent
+                pacing -= 1
             self.create_or_update_node_maintenance(node_state)
+            # stamp only after the handoff succeeded: a failed create must
+            # not burn an hour of pacing budget for a node never admitted
+            schedule.stamp_admission(common.provider, node)
             common.provider.change_node_upgrade_annotation(
                 node,
                 util.get_upgrade_requestor_mode_annotation_key(),

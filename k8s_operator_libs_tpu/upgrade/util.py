@@ -180,6 +180,11 @@ def get_quarantine_annotation_key() -> str:
     return consts.UPGRADE_QUARANTINE_ANNOTATION_KEY_FMT % get_component_name()
 
 
+def get_admitted_at_annotation_key() -> str:
+    """Admission timestamp (pacing gate) annotation key."""
+    return consts.UPGRADE_ADMITTED_AT_ANNOTATION_KEY_FMT % get_component_name()
+
+
 def get_event_reason() -> str:
     """Reference: GetEventReason (util.go:157-160)."""
     return "%sUpgrade" % get_component_name()

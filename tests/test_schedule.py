@@ -1,0 +1,314 @@
+"""Maintenance windows + admission pacing (upgrade/schedule.py)."""
+
+import time
+from datetime import datetime, timezone
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    MaintenanceWindowSpec,
+    UpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.api.upgrade_spec import ValidationError
+from k8s_operator_libs_tpu.upgrade import consts, schedule, util
+from k8s_operator_libs_tpu.upgrade.upgrade_state import ClusterUpgradeStateManager
+
+from harness import DRIVER_LABELS, NAMESPACE, Fleet
+
+
+def utc(*args):
+    return datetime(*args, tzinfo=timezone.utc)
+
+
+class TestWindowMath:
+    def test_inside_and_outside(self):
+        spec = MaintenanceWindowSpec(start="22:00", duration_minutes=240)
+        assert schedule.window_open(spec, utc(2026, 7, 29, 23, 30))
+        assert schedule.window_open(spec, utc(2026, 7, 29, 22, 0))
+        assert not schedule.window_open(spec, utc(2026, 7, 29, 21, 59))
+        assert not schedule.window_open(spec, utc(2026, 7, 30, 2, 0))
+
+    def test_midnight_crossing(self):
+        spec = MaintenanceWindowSpec(start="22:00", duration_minutes=360)
+        # 03:00 next day is inside yesterday's window
+        assert schedule.window_open(spec, utc(2026, 7, 30, 3, 0))
+        assert not schedule.window_open(spec, utc(2026, 7, 30, 4, 0))
+
+    def test_days_filter_applies_to_window_start_day(self):
+        # Fri 22:00 + 6h: Sat 03:00 is covered (window STARTED Friday)
+        spec = MaintenanceWindowSpec(
+            start="22:00", duration_minutes=360, days=("Fri",)
+        )
+        assert schedule.window_open(spec, utc(2026, 7, 31, 23, 0))  # Fri
+        assert schedule.window_open(spec, utc(2026, 8, 1, 3, 0))  # Sat 03:00
+        assert not schedule.window_open(spec, utc(2026, 7, 30, 23, 0))  # Thu
+
+    def test_validation(self):
+        MaintenanceWindowSpec(start="07:30", duration_minutes=60).validate()
+        with pytest.raises(ValidationError):
+            MaintenanceWindowSpec(start="25:00").validate()
+        with pytest.raises(ValidationError):
+            MaintenanceWindowSpec(start="nope").validate()
+        with pytest.raises(ValidationError):
+            MaintenanceWindowSpec(duration_minutes=0).validate()
+        with pytest.raises(ValidationError):
+            MaintenanceWindowSpec(days=("Funday",)).validate()
+
+    def test_round_trip(self):
+        spec = MaintenanceWindowSpec(
+            start="22:00", duration_minutes=240, days=("Sat", "Sun")
+        )
+        assert MaintenanceWindowSpec.from_dict(spec.to_dict()) == spec
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, maintenance_window=spec, max_nodes_per_hour=7
+        )
+        d = policy.to_dict()
+        assert d["maintenanceWindow"]["days"] == ["Sat", "Sun"]
+        assert d["maxNodesPerHour"] == 7
+        back = UpgradePolicySpec.from_dict(d)
+        assert back.maintenance_window == spec
+        assert back.max_nodes_per_hour == 7
+
+
+def _reconcile(manager, fleet, policy, cycles=1):
+    for _ in range(cycles):
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        manager.apply_state(state, policy)
+        manager.drain_manager.wait_idle(10)
+        manager.pod_manager.wait_idle(10)
+        fleet.reconcile_daemonset()
+
+
+def _make_manager(cluster):
+    return ClusterUpgradeStateManager(
+        cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+    )
+
+
+class TestWindowGatesAdmission:
+    def _fleet(self, cluster, n=2):
+        fleet = Fleet(cluster)
+        for i in range(n):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        return fleet
+
+    def test_closed_window_blocks_open_window_admits(
+        self, cluster, monkeypatch
+    ):
+        fleet = self._fleet(cluster)
+        manager = _make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            maintenance_window=MaintenanceWindowSpec(
+                start="22:00", duration_minutes=60
+            ),
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        monkeypatch.setattr(
+            schedule, "_now_utc", lambda: utc(2026, 7, 29, 12, 0)
+        )
+        _reconcile(manager, fleet, policy, cycles=3)
+        assert set(fleet.states().values()) == {
+            consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        }
+        monkeypatch.setattr(
+            schedule, "_now_utc", lambda: utc(2026, 7, 29, 22, 30)
+        )
+        for _ in range(15):
+            _reconcile(manager, fleet, policy)
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+
+    def test_mid_flight_node_finishes_outside_window(
+        self, cluster, monkeypatch
+    ):
+        fleet = self._fleet(cluster, n=1)
+        manager = _make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            maintenance_window=MaintenanceWindowSpec(
+                start="22:00", duration_minutes=60
+            ),
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        # admitted inside the window...
+        monkeypatch.setattr(
+            schedule, "_now_utc", lambda: utc(2026, 7, 29, 22, 59)
+        )
+        _reconcile(manager, fleet, policy, cycles=3)
+        assert fleet.node_state("n0") not in (
+            "",
+            consts.UPGRADE_STATE_UPGRADE_REQUIRED,
+        )
+        # ...window closes mid-flight: the node still runs to done
+        monkeypatch.setattr(
+            schedule, "_now_utc", lambda: utc(2026, 7, 29, 23, 30)
+        )
+        for _ in range(15):
+            _reconcile(manager, fleet, policy)
+            if fleet.node_state("n0") == consts.UPGRADE_STATE_DONE:
+                break
+        assert fleet.node_state("n0") == consts.UPGRADE_STATE_DONE
+
+
+class TestPacing:
+    def test_hourly_budget_counts_admitted_at_stamps(self, cluster):
+        fleet = Fleet(cluster)
+        for i in range(4):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = _make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            max_nodes_per_hour=2,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        _reconcile(manager, fleet, policy, cycles=2)
+        admitted = [
+            n
+            for n, s in fleet.states().items()
+            if s != consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        ]
+        assert len(admitted) == 2  # budget caps the wave
+        # stamps recorded
+        key = util.get_admitted_at_annotation_key()
+        for name in admitted:
+            node = cluster.get("Node", name)
+            assert key in node["metadata"]["annotations"]
+        # even many cycles later (same hour) nothing more is admitted
+        _reconcile(manager, fleet, policy, cycles=10)
+        still_pending = [
+            n
+            for n, s in fleet.states().items()
+            if s == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        ]
+        assert len(still_pending) == 2
+
+    def test_budget_frees_after_window_elapses(self, cluster):
+        fleet = Fleet(cluster)
+        for i in range(2):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = _make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            max_nodes_per_hour=1,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        _reconcile(manager, fleet, policy, cycles=2)
+        # one admitted; age its stamp past the trailing hour
+        key = util.get_admitted_at_annotation_key()
+        for node in cluster.list("Node"):
+            raw = node["metadata"]["annotations"].get(key)
+            if raw:
+                cluster.patch(
+                    "Node",
+                    node["metadata"]["name"],
+                    {
+                        "metadata": {
+                            "annotations": {key: repr(time.time() - 3700)}
+                        }
+                    },
+                )
+        for _ in range(15):
+            _reconcile(manager, fleet, policy)
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+
+    def test_slice_mode_domain_must_fit_budget(self, cluster):
+        SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
+        fleet = Fleet(cluster)
+        for h in range(4):
+            fleet.add_node(
+                f"s0-h{h}", pod_hash="rev1", labels={SLICE_KEY: "s0"}
+            )
+        fleet.add_node("solo", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = _make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            slice_aware=True,
+            max_nodes_per_hour=2,  # the 4-host slice does NOT fit
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        _reconcile(manager, fleet, policy, cycles=2)
+        states = fleet.states()
+        # the slice is deferred (atomic, larger than the budget); the
+        # singleton fits and goes
+        assert states["solo"] != consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        assert all(
+            states[f"s0-h{h}"] == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+            for h in range(4)
+        )
+
+    def test_multi_day_window_stays_open(self):
+        """Regression: a 3-day weekend window starting Saturday must still
+        be open on Monday morning."""
+        spec = MaintenanceWindowSpec(
+            start="00:00", duration_minutes=3 * 1440, days=("Sat",)
+        )
+        assert schedule.window_open(spec, utc(2026, 8, 3, 10, 0))  # Mon
+        assert not schedule.window_open(spec, utc(2026, 8, 4, 10, 0))  # Tue
+
+    def test_unsatisfiable_domain_warns(self, cluster, caplog):
+        """A domain bigger than maxNodesPerHour can never be admitted —
+        the scheduler must say so instead of deferring silently."""
+        import logging
+
+        SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
+        fleet = Fleet(cluster)
+        for h in range(4):
+            fleet.add_node(
+                f"s0-h{h}", pod_hash="rev1", labels={SLICE_KEY: "s0"}
+            )
+        fleet.publish_new_revision("rev2")
+        manager = _make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            slice_aware=True,
+            max_nodes_per_hour=2,
+        )
+        with caplog.at_level(
+            logging.WARNING, logger="k8s_operator_libs_tpu.upgrade.upgrade_inplace"
+        ):
+            _reconcile(manager, fleet, policy, cycles=2)
+        assert any("can never be admitted" in r.message for r in caplog.records)
+
+    def test_bypass_admissions_do_not_burn_pacing_budget(self, cluster):
+        """Regression: a manually cordoned node admitted via the throttle
+        bypass must not be stamped — it would starve the next hour's
+        budget for regular admissions."""
+        fleet = Fleet(cluster)
+        fleet.add_node("cordoned", pod_hash="rev1", unschedulable=True)
+        fleet.add_node("regular", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = _make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),
+            max_nodes_per_hour=1,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        _reconcile(manager, fleet, policy, cycles=2)
+        key = util.get_admitted_at_annotation_key()
+        cordoned = cluster.get("Node", "cordoned")
+        # the bypass admission carries no stamp
+        assert key not in (cordoned["metadata"].get("annotations") or {})
