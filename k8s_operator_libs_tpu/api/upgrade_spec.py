@@ -288,6 +288,11 @@ class UpgradePolicySpec:
     maintenance_window: Optional[MaintenanceWindowSpec] = None
     #: At most this many node admissions per trailing hour; 0 = unlimited.
     max_nodes_per_hour: int = 0
+    #: Canary staging: only this many domains are admitted first; the rest
+    #: of the fleet waits until every canary reaches upgrade-done.  A
+    #: failed canary freezes the rollout (nothing further is admitted
+    #: until it heals or is repaired).  0 = no canary stage.
+    canary_domains: int = 0
 
     def __post_init__(self) -> None:
         if isinstance(self.max_unavailable, (int, str)):
@@ -299,6 +304,7 @@ class UpgradePolicySpec:
         _require_bool("quarantineDegraded", self.quarantine_degraded)
         _require_non_negative("maxParallelUpgrades", self.max_parallel_upgrades)
         _require_non_negative("maxNodesPerHour", self.max_nodes_per_hour)
+        _require_non_negative("canaryDomains", self.canary_domains)
         if self.maintenance_window is not None:
             self.maintenance_window.validate()
         for sub in (
@@ -336,6 +342,8 @@ class UpgradePolicySpec:
             out["maintenanceWindow"] = self.maintenance_window.to_dict()
         if self.max_nodes_per_hour:
             out["maxNodesPerHour"] = self.max_nodes_per_hour
+        if self.canary_domains:
+            out["canaryDomains"] = self.canary_domains
         return out
 
     @classmethod
@@ -373,4 +381,5 @@ class UpgradePolicySpec:
                 else None
             ),
             max_nodes_per_hour=d.get("maxNodesPerHour", 0),
+            canary_domains=d.get("canaryDomains", 0),
         )

@@ -81,6 +81,8 @@ class InplaceNodeStateManager:
         pacing = schedule.pacing_budget(
             policy, (ns.node for ns in state.all_node_states())
         )
+        if policy.canary_domains > 0:
+            available = self._canary_cap(state, policy, available)
 
         node_states = state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED)
         quarantined = self._quarantined_domains(state, policy)
@@ -95,6 +97,66 @@ class InplaceNodeStateManager:
             )
         else:
             self._schedule_by_node(node_states, available, quarantined, pacing)
+
+    def _canary_cap(
+        self,
+        state: ClusterUpgradeState,
+        policy: UpgradePolicySpec,
+        available: int,
+    ) -> int:
+        """Canary staging (``policy.canary_domains`` > 0): the rollout
+        admits at most that many domains until every one of them reaches
+        upgrade-done; only then does the fleet open up.  A failed canary
+        therefore freezes the rollout — exactly the blast-radius contract
+        a canary exists to give.
+
+        Stateless: a unit (domain when slice_aware, node otherwise — the
+        census must use the same unit admissions spend) "participates"
+        when a member node carries the admitted-at stamp AND sits in an
+        active or done bucket; stamps on upgrade-required/unknown nodes
+        are leftovers from a PREVIOUS rollout generation (the stamp
+        itself is never cleared — pacing's trailing-hour count must
+        survive generations) and are ignored.  A participant succeeded
+        when all its nodes are upgrade-done."""
+        from ..cluster.objects import get_annotation, name_of
+
+        key = util.get_admitted_at_annotation_key()
+
+        def unit_of(node):
+            if policy.slice_aware:
+                return topology.domain_of(node)
+            return "node:" + name_of(node)
+
+        current_gen_buckets = consts.ACTIVE_STATES + (
+            consts.UPGRADE_STATE_DONE,
+        )
+        stamped = set()
+        not_done = set()
+        for bucket, node_states in state.node_states.items():
+            if bucket not in consts.ALL_STATES:
+                continue
+            for ns in node_states:
+                unit = unit_of(ns.node)
+                if bucket in current_gen_buckets and get_annotation(
+                    ns.node, key
+                ):
+                    stamped.add(unit)
+                if bucket != consts.UPGRADE_STATE_DONE:
+                    not_done.add(unit)
+        successful = stamped - not_done
+        if len(successful) >= policy.canary_domains:
+            return available  # canary stage passed: fleet opens up
+        remaining = max(0, policy.canary_domains - len(stamped))
+        if remaining < available:
+            logger.info(
+                "canary stage: %d/%d domains succeeded, %d in flight — "
+                "capping admissions to %d",
+                len(successful),
+                policy.canary_domains,
+                len(stamped) - len(successful),
+                remaining,
+            )
+        return min(available, remaining)
 
     def _quarantined_domains(
         self, state: ClusterUpgradeState, policy: UpgradePolicySpec

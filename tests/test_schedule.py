@@ -312,3 +312,196 @@ class TestPacing:
         cordoned = cluster.get("Node", "cordoned")
         # the bypass admission carries no stamp
         assert key not in (cordoned["metadata"].get("annotations") or {})
+
+
+class TestCanary:
+    SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
+
+    def _fleet(self, cluster, slices=3, hosts=2):
+        fleet = Fleet(cluster)
+        for s in range(slices):
+            for h in range(hosts):
+                fleet.add_node(
+                    f"s{s}-h{h}",
+                    pod_hash="rev1",
+                    labels={self.SLICE_KEY: f"s{s}"},
+                )
+        fleet.publish_new_revision("rev2")
+        return fleet
+
+    def _policy(self, **kw):
+        base = dict(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            slice_aware=True,
+            canary_domains=1,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        base.update(kw)
+        return UpgradePolicySpec(**base)
+
+    def test_only_canary_admitted_then_fleet_opens(self, cluster):
+        fleet = self._fleet(cluster)
+        manager = _make_manager(cluster)
+        policy = self._policy()
+        _reconcile(manager, fleet, policy, cycles=2)
+        started_domains = {
+            n.split("-")[0]
+            for n, s in fleet.states().items()
+            if s != consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        }
+        assert len(started_domains) == 1  # exactly the canary
+        # run to completion: once the canary is done the rest follow
+        for _ in range(30):
+            _reconcile(manager, fleet, policy)
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+
+    def test_failed_canary_freezes_rollout(self, cluster):
+        fleet = self._fleet(cluster)
+        manager = _make_manager(cluster)
+        policy = self._policy()
+        _reconcile(manager, fleet, policy, cycles=2)
+        canary_nodes = [
+            n
+            for n, s in fleet.states().items()
+            if s != consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        ]
+        # force the canary domain into upgrade-failed
+        for name in canary_nodes:
+            cluster.patch(
+                "Node",
+                name,
+                {
+                    "metadata": {
+                        "labels": {
+                            util.get_upgrade_state_label_key(): (
+                                consts.UPGRADE_STATE_FAILED
+                            )
+                        }
+                    }
+                },
+            )
+        _reconcile(manager, fleet, policy, cycles=5)
+        # nothing else was admitted while the canary is failed
+        others = {
+            n: s
+            for n, s in fleet.states().items()
+            if n not in canary_nodes
+        }
+        assert set(others.values()) == {consts.UPGRADE_STATE_UPGRADE_REQUIRED}
+
+    def test_second_rollout_generation_restages_canary(self, cluster):
+        """Regression: admitted-at stamps from a completed rollout must
+        not satisfy (or wedge) the NEXT rollout's canary stage."""
+        fleet = self._fleet(cluster, slices=2)
+        manager = _make_manager(cluster)
+        policy = self._policy()
+        for _ in range(30):
+            _reconcile(manager, fleet, policy)
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+        # next generation
+        fleet.publish_new_revision("rev3")
+        _reconcile(manager, fleet, policy, cycles=3)
+        started_domains = {
+            n.split("-")[0]
+            for n, s in fleet.states().items()
+            if s
+            not in (
+                consts.UPGRADE_STATE_UPGRADE_REQUIRED,
+                consts.UPGRADE_STATE_DONE,
+            )
+        }
+        # canary staging applies afresh: at most one domain in flight
+        assert len(started_domains) <= 1
+        for _ in range(30):
+            _reconcile(manager, fleet, policy)
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+
+    def test_node_mode_canary_via_singletons(self, cluster):
+        fleet = Fleet(cluster)
+        for i in range(3):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = _make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            canary_domains=1,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        _reconcile(manager, fleet, policy, cycles=2)
+        started = [
+            n
+            for n, s in fleet.states().items()
+            if s != consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        ]
+        assert len(started) == 1
+        for _ in range(30):
+            _reconcile(manager, fleet, policy)
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+
+    def test_node_mode_canary_on_slice_labeled_nodes(self, cluster):
+        """Regression: node-mode canary must count per NODE even when the
+        nodes carry slice labels (census unit must match the admission
+        unit or the rollout wedges after the first canary node)."""
+        fleet = Fleet(cluster)
+        for s in range(2):
+            for h in range(2):
+                fleet.add_node(
+                    f"s{s}-h{h}",
+                    pod_hash="rev1",
+                    labels={self.SLICE_KEY: f"s{s}"},
+                )
+        fleet.publish_new_revision("rev2")
+        manager = _make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            slice_aware=False,  # node-granular admissions
+            canary_domains=1,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        for _ in range(40):
+            _reconcile(manager, fleet, policy)
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+
+    def test_pacing_record_survives_generations(self, cluster):
+        """Regression: a new rollout generation must NOT erase admitted-at
+        stamps — back-to-back generations would otherwise double the
+        hourly disruption cap."""
+        fleet = Fleet(cluster)
+        for i in range(2):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager = _make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            max_nodes_per_hour=2,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        for _ in range(15):
+            _reconcile(manager, fleet, policy)
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+        # generation 2 within the same hour: budget already spent
+        fleet.publish_new_revision("rev3")
+        _reconcile(manager, fleet, policy, cycles=5)
+        assert set(fleet.states().values()) == {
+            consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        }, "hourly budget must still be exhausted from generation 1"
