@@ -40,6 +40,35 @@ class ModelConfig:
     d_ff: int = 256
     max_seq_len: int = 64
     dtype: Any = jnp.float32  # bfloat16 on real TPU
+    #: Mesh axis name for sequence parallelism (None = off).  When set,
+    #: layernorm/MLP activations are sharded over the sequence dimension
+    #: (Megatron-style SP) and XLA inserts the all-gather before
+    #: attention / reduce-scatter after it — long sequences then cost
+    #: 1/sp of the activation memory outside attention.
+    seq_axis: Any = None
+
+
+import threading as _threading
+
+_seq_sharding_flag = _threading.local()
+
+
+def _seq_constrain(x, cfg: "ModelConfig", seq_sharded: bool):
+    """Activation layout hint for sequence parallelism: (batch, seq, d)
+    sharded over ``seq_axis`` in the elementwise/MLP regions, gathered to
+    full sequence for attention (causal attention needs every position).
+
+    Only active while a train step is being traced (the flag below):
+    ``model.init`` runs eagerly with a batch of 1, which no data-axis
+    sharding divides."""
+    if cfg.seq_axis is None or not getattr(_seq_sharding_flag, "on", False):
+        return x
+    spec = (
+        P("data", cfg.seq_axis, None)
+        if seq_sharded
+        else P("data", None, None)
+    )
+    return jax.lax.with_sharding_constraint(x, spec)
 
 
 class Block(nn.Module):
@@ -51,6 +80,9 @@ class Block(nn.Module):
     def __call__(self, x):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_attn")(x)
+        # attention needs the full sequence: gather (XLA all-gather over
+        # the seq axis when sequence parallelism is on)
+        h = _seq_constrain(h, cfg, seq_sharded=False)
         h = nn.MultiHeadDotProductAttention(
             num_heads=cfg.n_heads,
             dtype=cfg.dtype,
@@ -59,6 +91,8 @@ class Block(nn.Module):
             name="attn",
         )(h, mask=nn.make_causal_mask(jnp.ones(h.shape[:2], dtype=bool)))
         x = x + h
+        # elementwise + MLP region: re-shard over the sequence axis
+        x = _seq_constrain(x, cfg, seq_sharded=True)
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_mlp")(x)
         h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="mlp_up")(h)
         h = nn.gelu(h)
@@ -81,6 +115,7 @@ class TinyLM(nn.Module):
             cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, name="pos_embed"
         )(jnp.arange(tokens.shape[1])[None, :])
         x = x + pos
+        x = _seq_constrain(x, cfg, seq_sharded=True)
         for i in range(cfg.n_layers):
             x = Block(cfg, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
@@ -94,18 +129,21 @@ def make_mesh(
     n_devices: Optional[int] = None,
     dp: Optional[int] = None,
     tp: Optional[int] = None,
+    sp: int = 1,
 ) -> Mesh:
-    """A (data, model) mesh.  Defaults: all devices, tp = min(n, d_model
-    divisor 2) — callers pick explicit dp×tp for real topologies."""
+    """A (data, seq, model) mesh.  ``sp=1`` (default) degenerates to the
+    plain dp×tp layout; with ``sp>1`` pass a config with
+    ``seq_axis="seq"`` so activations shard over the sequence dimension.
+    Callers pick explicit dp×sp×tp for real topologies."""
     devices = jax.devices()
     n = n_devices or len(devices)
     if dp is None or tp is None:
         tp = tp or (2 if n % 2 == 0 and n > 1 else 1)
-        dp = dp or n // tp
-    if dp * tp != n:
-        raise ValueError(f"dp({dp}) * tp({tp}) != devices({n})")
-    dev_array = np.array(devices[:n]).reshape(dp, tp)
-    return Mesh(dev_array, axis_names=("data", "model"))
+        dp = dp or n // (tp * sp)
+    if dp * sp * tp != n:
+        raise ValueError(f"dp({dp}) * sp({sp}) * tp({tp}) != devices({n})")
+    dev_array = np.array(devices[:n]).reshape(dp, sp, tp)
+    return Mesh(dev_array, axis_names=("data", "seq", "model"))
 
 
 def param_partition_spec(path: Tuple[str, ...], leaf: jax.Array) -> P:
@@ -174,12 +212,17 @@ def make_train_step(model: TinyLM, tx, mesh: Optional[Mesh] = None):
 
     def step(params, opt_state, tokens):
         if mesh is not None:
+            seq = model.config.seq_axis
             tokens = jax.lax.with_sharding_constraint(
-                tokens, NamedSharding(mesh, P("data", None))
+                tokens, NamedSharding(mesh, P("data", seq))
             )
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(model, p, tokens)
-        )(params)
+        _seq_sharding_flag.on = mesh is not None
+        try:
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(model, p, tokens)
+            )(params)
+        finally:
+            _seq_sharding_flag.on = False
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
