@@ -130,6 +130,11 @@ class DrainSpec:
     #: PodDisruptionBudgets.  Default False — drains evict and retry on
     #: PDB 429s until the drain timeout.
     disable_eviction: bool = False
+    #: Pod termination grace period for drain deletions/evictions;
+    #: -1 = each pod's own ``spec.terminationGracePeriodSeconds``
+    #: (kubectl --grace-period default; the reference pins -1 on the
+    #: drain.Helper at drain_manager.go:76-96), 0 = force-kill.
+    grace_period_seconds: int = -1
 
     def validate(self) -> None:
         _require_non_negative("drain.timeoutSeconds", self.timeout_second)
@@ -137,6 +142,13 @@ class DrainSpec:
         _require_bool("drain.force", self.force)
         _require_bool("drain.deleteEmptyDir", self.delete_empty_dir)
         _require_bool("drain.disableEviction", self.disable_eviction)
+        if not isinstance(self.grace_period_seconds, int) or (
+            self.grace_period_seconds < -1
+        ):
+            raise ValidationError(
+                "drain.gracePeriodSeconds must be an integer >= -1, got "
+                f"{self.grace_period_seconds!r}"
+            )
 
     def to_dict(self) -> Dict[str, Any]:
         out = {
@@ -148,6 +160,8 @@ class DrainSpec:
         }
         if self.disable_eviction:
             out["disableEviction"] = True
+        if self.grace_period_seconds != -1:
+            out["gracePeriodSeconds"] = self.grace_period_seconds
         return out
 
     @classmethod
@@ -159,6 +173,7 @@ class DrainSpec:
             timeout_second=d.get("timeoutSeconds", 300),
             delete_empty_dir=d.get("deleteEmptyDir", False),
             disable_eviction=d.get("disableEviction", False),
+            grace_period_seconds=d.get("gracePeriodSeconds", -1),
         )
 
 

@@ -44,7 +44,7 @@ from .errors import (
     NotFoundError,
     TooManyRequestsError,
 )
-from .selectors import parse_selector
+from .selectors import match_label_selector, parse_selector
 
 JsonObj = Dict[str, Any]
 Key = Tuple[str, str, str]  # (kind, namespace, name)
@@ -106,7 +106,11 @@ class WatchEvent:
 class InMemoryCluster:
     """A stand-in kube-apiserver holding typed-but-schemaless JSON objects."""
 
-    def __init__(self, crd_establish_delay_seconds: float = 0.0) -> None:
+    def __init__(
+        self,
+        crd_establish_delay_seconds: float = 0.0,
+        termination_grace_scale: float = 1.0,
+    ) -> None:
         self._lock = threading.RLock()
         self._store: Dict[Key, JsonObj] = {}
         self._rv = 0
@@ -115,6 +119,12 @@ class InMemoryCluster:
         self._journal_floor = 0  # highest seq evicted from the journal
         #: A real apiserver establishes CRDs asynchronously; 0 = synchronous.
         self.crd_establish_delay_seconds = crd_establish_delay_seconds
+        #: Simulation clock scale for pod graceful termination: a pod
+        #: deleted with grace period G lingers Terminating for
+        #: ``G * termination_grace_scale`` wall seconds before the
+        #: "kubelet" (a timer) confirms and the object is removed.  1.0 =
+        #: real time; tests use small scales so 30 s graces finish in ms.
+        self.termination_grace_scale = termination_grace_scale
         # Secondary indexes (the apiserver analog: etcd key prefixes per
         # type + the kubelet's spec.nodeName fieldSelector index).  At
         # fleet scale every per-node drain/eviction listing otherwise
@@ -345,18 +355,65 @@ class InMemoryCluster:
             self._record("Modified", old, json_copy(merged))
             return json_copy(merged)
 
-    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+    def delete(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
         """Delete an object.  Like a real apiserver, an object holding
         finalizers is only *marked* (deletionTimestamp set); it is removed
         once its finalizers are cleared via :meth:`update` — this is what
-        makes drain/eviction timeout paths testable."""
+        makes drain/eviction timeout paths testable.
+
+        Pods additionally honor **graceful termination**
+        (drain_manager.go:76-96 sets GracePeriodSeconds on the kubectl
+        helper; the real apiserver keeps the pod Terminating until the
+        kubelet confirms): effective grace is *grace_period_seconds* if
+        given and >= 0, else the pod's
+        ``spec.terminationGracePeriodSeconds``, else 0 (the simulator has
+        no kubelet, so K8s's 30 s default would only slow tests; deviation
+        documented in PARITY.md).  With positive grace the pod is marked
+        Terminating (deletionTimestamp + deletionGracePeriodSeconds) and
+        removed by a timer after ``grace * termination_grace_scale``
+        seconds.  ``grace 0`` on an already-Terminating pod force-removes
+        it (kubectl ``--grace-period=0``); a repeat graceful delete is a
+        no-op."""
         with self._lock:
             key = (kind, namespace, name)
             obj = self._store.get(key)
             if obj is None:
                 raise NotFoundError(f"{key} not found")
-            if (obj.get("metadata") or {}).get("finalizers"):
-                if not obj["metadata"].get("deletionTimestamp"):
+            meta = obj.get("metadata") or {}
+            if kind == "Pod":
+                if meta.get("deletionTimestamp"):
+                    if grace_period_seconds == 0 and not meta.get("finalizers"):
+                        self._store_pop(key)
+                        self._next_rv()
+                        self._record("Deleted", json_copy(obj), None)
+                    return  # already terminating
+                grace = grace_period_seconds
+                if grace is None or grace < 0:
+                    grace = (obj.get("spec") or {}).get(
+                        "terminationGracePeriodSeconds"
+                    ) or 0
+                if grace > 0:
+                    old = json_copy(obj)
+                    meta["deletionTimestamp"] = time.time()
+                    meta["deletionGracePeriodSeconds"] = grace
+                    meta["resourceVersion"] = self._next_rv()
+                    self._record("Modified", old, json_copy(obj))
+                    t = threading.Timer(
+                        grace * self.termination_grace_scale,
+                        self._reap_terminating_pod,
+                        args=(key, meta["uid"]),
+                    )
+                    t.daemon = True
+                    t.start()
+                    return
+            if meta.get("finalizers"):
+                if not meta.get("deletionTimestamp"):
                     old = json_copy(obj)
                     obj["metadata"]["deletionTimestamp"] = time.time()
                     obj["metadata"]["resourceVersion"] = self._next_rv()
@@ -366,8 +423,27 @@ class InMemoryCluster:
             self._next_rv()  # deletions advance the version sequence too
             self._record("Deleted", json_copy(obj), None)
 
+    def _reap_terminating_pod(self, key: Key, uid: str) -> None:
+        """The "kubelet confirmed termination" moment for a gracefully
+        deleted pod.  Finalizers still defer actual removal (cleared via
+        :meth:`update`/:meth:`patch`, same as any terminating object)."""
+        with self._lock:
+            obj = self._store.get(key)
+            if obj is None or obj["metadata"].get("uid") != uid:
+                return  # already gone or name reused
+            if obj["metadata"].get("finalizers"):
+                return
+            self._store_pop(key)
+            self._next_rv()
+            self._record("Deleted", json_copy(obj), None)
+
     # ------------------------------------------------------------ eviction API
-    def evict(self, name: str, namespace: str = "") -> None:
+    def evict(
+        self,
+        name: str,
+        namespace: str = "",
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
         """Eviction-subresource analog: delete the pod UNLESS a matching
         PodDisruptionBudget has no disruptions left, in which case raise
         :class:`TooManyRequestsError` (the 429 kubectl drain retries on).
@@ -382,15 +458,18 @@ class InMemoryCluster:
           ``minAvailable`` ⇒ ``healthy - required > 0``;
           ``maxUnavailable`` ⇒ ``max_unavailable - (expected - healthy)
           > 0``; percentages resolve against the matching pod count with
-          round-up (GetScaledValueFromIntOrPercent, roundUp=true).
+          round-up (GetScaledValueFromIntOrPercent, roundUp=true);
+        * the PDB selector is matched with full LabelSelector semantics
+          (``matchLabels`` AND ``matchExpressions`` — see
+          :func:`~.selectors.match_label_selector`); a PDB without a
+          selector protects nothing;
+        * *grace_period_seconds* carries the Eviction object's
+          ``deleteOptions.gracePeriodSeconds`` through to the delete.
 
         The budget check and the delete happen under ONE hold of the
         store lock (it is re-entrant), so concurrent evictions cannot
         jointly overdraw a budget."""
         from ..api.intstr import IntOrString
-
-        def label_matches(match_labels, labels):
-            return all(labels.get(k) == v for k, v in match_labels.items())
 
         with self._lock:
             key = ("Pod", namespace, name)
@@ -405,16 +484,14 @@ class InMemoryCluster:
                     pdb = self._store.get(pdb_key)
                     if pdb is None or pdb_key[1] != namespace:
                         continue
-                    selector = (
-                        (pdb.get("spec") or {}).get("selector") or {}
-                    ).get("matchLabels") or {}
-                    if not label_matches(selector, pod_labels):
+                    selector = (pdb.get("spec") or {}).get("selector")
+                    if not match_label_selector(selector, pod_labels):
                         continue
                     matching = [
                         self._store[k]
                         for k in self._by_kind.get("Pod") or ()
                         if k[1] == namespace
-                        and label_matches(
+                        and match_label_selector(
                             selector,
                             (self._store[k].get("metadata") or {}).get(
                                 "labels"
@@ -449,7 +526,12 @@ class InMemoryCluster:
                         )
             # budget permits (or terminal / no PDB matched): graceful
             # delete inside the same lock hold (RLock — re-entrant)
-            self.delete("Pod", name, namespace)
+            self.delete(
+                "Pod",
+                name,
+                namespace,
+                grace_period_seconds=grace_period_seconds,
+            )
 
     @staticmethod
     def _pod_healthy(pod: JsonObj) -> bool:

@@ -98,3 +98,47 @@ def matches(selector: str, labels: Mapping[str, str] | None) -> bool:
 def labels_to_selector(labels: Dict[str, str]) -> str:
     """Reference: labels.SelectorFromSet — exact-match conjunction."""
     return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
+
+def match_label_selector(selector: Mapping | None, labels: Mapping[str, str] | None) -> bool:
+    """Match a Kubernetes ``LabelSelector`` OBJECT (``matchLabels`` +
+    ``matchExpressions``) against a labels map — the selector form PDBs,
+    DaemonSets and Deployments carry in their specs
+    (metav1.LabelSelectorAsSelector semantics).
+
+    * ``matchLabels`` and ``matchExpressions`` requirements are ANDed;
+    * operators: ``In``, ``NotIn``, ``Exists``, ``DoesNotExist``;
+    * a MISSING selector (``None``) matches nothing, while an EMPTY
+      selector object (``{}``, no requirements) matches everything —
+      the policy/v1 apiserver contract for PDB-style specs.
+
+    Raises :class:`SelectorParseError` on an unknown operator, so a
+    malformed PDB fails loudly instead of silently protecting nothing.
+    """
+    if selector is None:
+        return False
+    labels = labels or {}
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if labels.get(k) != v:
+            return False
+    for req in selector.get("matchExpressions") or []:
+        key = req.get("key")
+        op = req.get("operator")
+        values = req.get("values") or []
+        if op == "In":
+            if labels.get(key) not in values:
+                return False
+        elif op == "NotIn":
+            if key in labels and labels[key] in values:
+                return False
+        elif op == "Exists":
+            if key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if key in labels:
+                return False
+        else:
+            raise SelectorParseError(
+                f"unknown matchExpressions operator {op!r} for key {key!r}"
+            )
+    return True

@@ -132,6 +132,16 @@ UPGRADE_VALIDATION_START_TIME_ANNOTATION_KEY_FMT = (
 #: (drives the max-nodes-per-hour pacing gate; see upgrade/schedule.py).
 UPGRADE_ADMITTED_AT_ANNOTATION_KEY_FMT = DOMAIN + "/%s-upgrade.admitted-at"
 
+#: Node annotation marking the admission as a throttle BYPASS (manually
+#: cordoned node, or straggler of an already-active domain).  Bypass
+#: admissions carry the admitted-at stamp — the canary census must see
+#: them, or the blast radius could exceed canaryDomains — but are exempt
+#: from hourly pacing (their domain is already disrupted), which this
+#: marker records.  Cleared when the node is later admitted normally.
+UPGRADE_ADMITTED_BYPASS_ANNOTATION_KEY_FMT = (
+    DOMAIN + "/%s-upgrade.admitted-bypass"
+)
+
 #: TPU-native: node annotation marking the host's slice domain as
 #: quarantined because a domain member has a degraded TPU (value = the
 #: domain id); maintained by tpu.health.SliceHealthManager.

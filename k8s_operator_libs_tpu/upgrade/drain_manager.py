@@ -160,12 +160,21 @@ class DrainHelper:
             blocked: List[JsonObj] = []
             for pod in to_evict:
                 try:
+                    # kubectl semantics: grace -1 = pod's own
+                    # terminationGracePeriodSeconds (the store resolves it)
                     if self._config.disable_eviction:
                         self._cluster.delete(
-                            "Pod", name_of(pod), namespace_of(pod)
+                            "Pod",
+                            name_of(pod),
+                            namespace_of(pod),
+                            grace_period_seconds=self._config.grace_period_seconds,
                         )
                     else:
-                        self._cluster.evict(name_of(pod), namespace_of(pod))
+                        self._cluster.evict(
+                            name_of(pod),
+                            namespace_of(pod),
+                            grace_period_seconds=self._config.grace_period_seconds,
+                        )
                 except NotFoundError:
                     pass
                 except TooManyRequestsError:
@@ -281,6 +290,7 @@ class DrainManager:
                     force=spec.force,
                     delete_empty_dir=spec.delete_empty_dir,
                     ignore_all_daemon_sets=True,
+                    grace_period_seconds=spec.grace_period_seconds,
                     timeout_seconds=spec.timeout_second,
                     pod_selector=spec.pod_selector,
                     disable_eviction=spec.disable_eviction,

@@ -71,9 +71,13 @@ class RolloutStatus:
     Counter semantics (matching the census the throttle uses,
     common_manager.go:730-737): ``failed`` is a SUBSET of
     ``in_progress`` — a failed node still occupies an active-state
-    bucket and a throttle slot until it self-heals or is repaired.  So
-    ``done + in_progress + pending (+ unknown) == total_nodes``, and
-    consumers must NOT additionally subtract ``failed``."""
+    bucket and a throttle slot until it self-heals or is repaired.
+    ``unknown`` counts nodes with no state label yet AND nodes whose
+    state label is unrecognized (corrupted) — both need the state
+    machine's attention before they can be classified.  The invariant
+    ``done + in_progress + pending + unknown == total_nodes`` therefore
+    holds for EVERY input, and consumers must NOT additionally subtract
+    ``failed``."""
 
     total_nodes: int
     by_state: Dict[str, int]
@@ -81,6 +85,7 @@ class RolloutStatus:
     in_progress: int
     pending: int
     failed: int
+    unknown: int
     domains: List[DomainStatus]
 
     # ------------------------------------------------------------- derived
@@ -111,7 +116,7 @@ class RolloutStatus:
         snapshot (the object ``build_state`` returns)."""
         by_state: Dict[str, int] = {}
         domains: Dict[str, DomainStatus] = {}
-        total = done = in_progress = pending = failed = 0
+        total = done = in_progress = pending = unknown = failed = 0
         for bucket, node_states in state.node_states.items():
             # UPGRADE_STATE_UNKNOWN is the empty string; surface it under a
             # readable key so JSON consumers don't special-case "".
@@ -125,6 +130,10 @@ class RolloutStatus:
                     pending += 1
                 elif bucket in consts.ACTIVE_STATES:
                     in_progress += 1
+                else:
+                    # no state label yet, or a corrupted/unrecognized one —
+                    # either way the bucket counts toward the invariant
+                    unknown += 1
                 if bucket == consts.UPGRADE_STATE_FAILED:
                     failed += 1
                 dom = topology.domain_of(ns.node)
@@ -147,6 +156,7 @@ class RolloutStatus:
             in_progress=in_progress,
             pending=pending,
             failed=failed,
+            unknown=unknown,
             domains=sorted(domains.values(), key=lambda d: d.domain),
         )
 
@@ -159,6 +169,7 @@ class RolloutStatus:
             "inProgress": self.in_progress,
             "pending": self.pending,
             "failed": self.failed,
+            "unknown": self.unknown,
             "percentDone": round(self.percent_done, 1),
             "complete": self.complete,
             "domains": [d.to_dict() for d in self.domains],
@@ -172,6 +183,7 @@ class RolloutStatus:
             f"{self.percent_done:.0f}%) — "
             f"inProgress {self.in_progress} "
             f"(of which failed {self.failed}) pending {self.pending}"
+            + (f" unknown {self.unknown}" if self.unknown else "")
         )
 
     def render(self) -> str:

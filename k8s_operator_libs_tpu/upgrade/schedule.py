@@ -30,7 +30,7 @@ from typing import Iterable, Optional
 
 from ..api.upgrade_spec import MaintenanceWindowSpec
 from ..cluster.inmem import JsonObj
-from . import util
+from . import consts, util
 
 #: Trailing window for admission pacing (seconds).
 PACING_WINDOW_SECONDS = 3600.0
@@ -75,15 +75,23 @@ def count_recent_admissions(
     now_ts: Optional[float] = None,
     window_seconds: float = PACING_WINDOW_SECONDS,
 ) -> int:
-    """Nodes whose admitted-at stamp lies inside the trailing window."""
+    """Nodes whose admitted-at stamp lies inside the trailing window.
+
+    Bypass admissions (see :func:`stamp_admission`) are excluded: their
+    domain was already disrupted, so counting them would let a burst of
+    bypasses starve the next hour's planned-admission budget."""
     if now_ts is None:
         now_ts = _time.time()
     key = util.get_admitted_at_annotation_key()
+    bypass_key = util.get_admitted_bypass_annotation_key()
     count = 0
     for node in nodes:
-        raw = ((node.get("metadata") or {}).get("annotations") or {}).get(key)
+        annotations = (node.get("metadata") or {}).get("annotations") or {}
+        raw = annotations.get(key)
         if not raw:
             continue
+        if annotations.get(bypass_key):
+            continue  # pacing-exempt bypass admission
         try:
             ts = float(raw)
         except ValueError:
@@ -93,13 +101,32 @@ def count_recent_admissions(
     return count
 
 
-def stamp_admission(provider, node: JsonObj, now_ts: Optional[float] = None) -> None:
-    """Record the admission time on the node (pacing survives restarts)."""
+def stamp_admission(
+    provider,
+    node: JsonObj,
+    now_ts: Optional[float] = None,
+    bypass: bool = False,
+) -> None:
+    """Record the admission time on the node (pacing survives restarts).
+
+    *bypass* marks a throttle-bypass admission (manually cordoned node,
+    active-domain straggler): the admitted-at stamp is still written so
+    the canary census sees the unit participating, but a companion
+    marker annotation exempts it from pacing.  A later NORMAL admission
+    of the same node clears the marker."""
     if now_ts is None:
         now_ts = _time.time()
     provider.change_node_upgrade_annotation(
         node, util.get_admitted_at_annotation_key(), repr(now_ts)
     )
+    bypass_key = util.get_admitted_bypass_annotation_key()
+    annotations = (node.get("metadata") or {}).get("annotations") or {}
+    if bypass:
+        provider.change_node_upgrade_annotation(node, bypass_key, "true")
+    elif annotations.get(bypass_key):
+        provider.change_node_upgrade_annotation(
+            node, bypass_key, consts.NULL_STRING
+        )
 
 
 def pacing_budget(policy, state_nodes: Iterable[JsonObj]) -> Optional[int]:

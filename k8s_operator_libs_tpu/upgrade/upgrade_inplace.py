@@ -22,7 +22,7 @@ host-by-host (see :mod:`..tpu.topology`).
 from __future__ import annotations
 
 import logging
-from typing import List
+from typing import List, Optional
 
 from ..api.upgrade_spec import UpgradePolicySpec
 from ..tpu import topology
@@ -81,8 +81,9 @@ class InplaceNodeStateManager:
         pacing = schedule.pacing_budget(
             policy, (ns.node for ns in state.all_node_states())
         )
+        canary = None
         if policy.canary_domains > 0:
-            available = self._canary_cap(state, policy, available)
+            canary = self._canary_budget(state, policy)
 
         node_states = state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED)
         quarantined = self._quarantined_domains(state, policy)
@@ -94,21 +95,30 @@ class InplaceNodeStateManager:
                 quarantined,
                 pacing,
                 pacing_limit=policy.max_nodes_per_hour,
+                canary=canary,
             )
         else:
-            self._schedule_by_node(node_states, available, quarantined, pacing)
+            self._schedule_by_node(
+                node_states, available, quarantined, pacing, canary=canary
+            )
 
-    def _canary_cap(
+    def _canary_budget(
         self,
         state: ClusterUpgradeState,
         policy: UpgradePolicySpec,
-        available: int,
-    ) -> int:
+    ) -> Optional[int]:
         """Canary staging (``policy.canary_domains`` > 0): the rollout
-        admits at most that many domains until every one of them reaches
+        admits at most that many units until every one of them reaches
         upgrade-done; only then does the fleet open up.  A failed canary
         therefore freezes the rollout — exactly the blast-radius contract
         a canary exists to give.
+
+        Returns the remaining canary admissions, or ``None`` once the
+        stage has passed (fleet open).  The canary is a cap on exposure
+        to the NEW VERSION, so it gates throttle-BYPASS admissions too
+        (manually cordoned nodes): those add no new unavailability, but
+        they absolutely add version exposure — the schedulers charge
+        every fresh unit admission, bypass or not, against this budget.
 
         Stateless: a unit (domain when slice_aware, node otherwise — the
         census must use the same unit admissions spend) "participates"
@@ -145,18 +155,21 @@ class InplaceNodeStateManager:
                     not_done.add(unit)
         successful = stamped - not_done
         if len(successful) >= policy.canary_domains:
-            return available  # canary stage passed: fleet opens up
+            return None  # canary stage passed: fleet opens up
         remaining = max(0, policy.canary_domains - len(stamped))
-        if remaining < available:
+        # Log only when the budget is actually holding work back — a
+        # soaking canary reconciles every few seconds for hours.
+        if remaining == 0 and state.nodes_in(
+            consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        ):
             logger.info(
                 "canary stage: %d/%d domains succeeded, %d in flight — "
-                "capping admissions to %d",
+                "admissions frozen until the canary completes",
                 len(successful),
                 policy.canary_domains,
                 len(stamped) - len(successful),
-                remaining,
             )
-        return min(available, remaining)
+        return remaining
 
     def _quarantined_domains(
         self, state: ClusterUpgradeState, policy: UpgradePolicySpec
@@ -207,6 +220,7 @@ class InplaceNodeStateManager:
         available: int,
         quarantined=None,
         pacing=None,
+        canary: Optional[int] = None,
     ) -> None:
         common = self._common
         for node_state in node_states:
@@ -227,18 +241,26 @@ class InplaceNodeStateManager:
                     continue
                 if pacing is not None and pacing <= 0:
                     continue  # hourly pacing budget spent
+            # The canary budget caps VERSION exposure, so it gates bypass
+            # admissions too — a cordoned node adds no new unavailability
+            # but still runs the new version.
+            if canary is not None and canary <= 0:
+                continue
             common.provider.change_node_upgrade_state(
                 node, consts.UPGRADE_STATE_CORDON_REQUIRED
             )
-            # bypass admissions (already cordoned) are continuations of an
-            # existing disruption: exempt from pacing — not stamped, not
-            # decremented — so they cannot starve later hours' budgets.
-            # The SLOT budget still decrements unconditionally (reference
-            # behavior, :87-97).
-            if not bypass:
-                schedule.stamp_admission(common.provider, node)
-                if pacing is not None:
-                    pacing -= 1
+            # Every admission is stamped — the canary census must see
+            # bypass admissions too, or blast radius could exceed
+            # canaryDomains — but bypasses (already cordoned) carry the
+            # pacing-exempt marker: they continue an existing disruption
+            # and must not starve later hours' budgets.  The SLOT budget
+            # still decrements unconditionally (reference behavior,
+            # :87-97).
+            schedule.stamp_admission(common.provider, node, bypass=bypass)
+            if not bypass and pacing is not None:
+                pacing -= 1
+            if canary is not None:
+                canary -= 1
             available -= 1
 
     def _schedule_by_domain(
@@ -249,6 +271,7 @@ class InplaceNodeStateManager:
         quarantined=None,
         pacing=None,
         pacing_limit: int = 0,
+        canary: Optional[int] = None,
     ) -> None:
         """Slice-aware scheduling: one slot = one domain; all of a chosen
         domain's upgrade-required nodes advance together.
@@ -277,9 +300,13 @@ class InplaceNodeStateManager:
             bypass = domain in active_domains or any(
                 common.is_node_unschedulable(n) for n in nodes
             )
+            # A FRESH unit enters version exposure with this admission;
+            # active-domain stragglers already did at their domain's
+            # original (stamped) admission.
+            fresh = domain not in active_domains
             # Quarantine bars STARTING a degraded domain; an already-active
             # domain still finishes (stranding it half-upgraded is worse).
-            if quarantined and domain in quarantined and domain not in active_domains:
+            if quarantined and domain in quarantined and fresh:
                 logger.info(
                     "domain %s is quarantined (degraded host), not admitting",
                     domain,
@@ -306,12 +333,20 @@ class InplaceNodeStateManager:
                             pacing_limit,
                         )
                     continue
+            # The canary budget caps VERSION exposure: every fresh domain
+            # — including cordoned-node bypasses — consumes it; active-
+            # domain stragglers are already counted via their stamp.
+            if canary is not None and fresh and canary <= 0:
+                continue
             for node in nodes:
                 common.provider.change_node_upgrade_state(
                     node, consts.UPGRADE_STATE_CORDON_REQUIRED
                 )
-                if not bypass:
-                    schedule.stamp_admission(common.provider, node)
+                # bypass admissions stamped too (canary census), with the
+                # pacing-exempt marker — see _schedule_by_node
+                schedule.stamp_admission(common.provider, node, bypass=bypass)
+            if canary is not None and fresh:
+                canary -= 1
             if not bypass:
                 available -= 1
                 if pacing is not None:

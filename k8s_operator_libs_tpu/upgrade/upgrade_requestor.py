@@ -323,12 +323,17 @@ class RequestorNodeStateManager:
         many nodes may be handed off per pass (upgrade/schedule.py)."""
         common = self._common
         self.set_default_node_maintenance(policy)
-        if (
+        # The window gates only the NodeMaintenance HANDOFF — the
+        # upgrade-requested annotation housekeeping the reference performs
+        # in ProcessUpgradeRequiredNodes (:283-296) runs regardless, so a
+        # closed window cannot leave the annotation stale until it next
+        # opens.
+        window_closed = (
             policy.maintenance_window is not None
             and not schedule.window_open(policy.maintenance_window)
-        ):
+        )
+        if window_closed:
             logger.info("outside maintenance window; no new maintenance handoffs")
-            return
         pacing = schedule.pacing_budget(
             policy, (ns.node for ns in state.all_node_states())
         )
@@ -343,6 +348,8 @@ class RequestorNodeStateManager:
             if common.skip_node_upgrade(node):
                 logger.info("node %s is marked to skip upgrades", name_of(node))
                 continue
+            if window_closed:
+                continue  # housekeeping done; handoff gated by the window
             if pacing is not None:
                 if pacing <= 0:
                     continue  # hourly pacing budget spent

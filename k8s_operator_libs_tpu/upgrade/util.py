@@ -185,6 +185,13 @@ def get_admitted_at_annotation_key() -> str:
     return consts.UPGRADE_ADMITTED_AT_ANNOTATION_KEY_FMT % get_component_name()
 
 
+def get_admitted_bypass_annotation_key() -> str:
+    """Throttle-bypass admission marker (pacing-exempt) annotation key."""
+    return (
+        consts.UPGRADE_ADMITTED_BYPASS_ANNOTATION_KEY_FMT % get_component_name()
+    )
+
+
 def get_event_reason() -> str:
     """Reference: GetEventReason (util.go:157-160)."""
     return "%sUpgrade" % get_component_name()

@@ -339,3 +339,62 @@ class TestStoreIndexes:
         assert fresh["status"].get("containerStatuses") != got["status"][
             "containerStatuses"
         ]
+
+
+class TestGracefulTermination:
+    """Pod graceful-termination window: delete with grace leaves the pod
+    Terminating (deletionTimestamp + deletionGracePeriodSeconds) until
+    the simulated kubelet (a timer scaled by termination_grace_scale)
+    confirms."""
+
+    def test_spec_grace_creates_terminating_window(self, cluster):
+        cluster.termination_grace_scale = 0.02
+        pod = make_pod("p0", "ml", "n1")
+        pod["spec"]["terminationGracePeriodSeconds"] = 3
+        cluster.create(pod)
+        cluster.delete("Pod", "p0", "ml")
+        cur = cluster.get("Pod", "p0", "ml")  # still present, terminating
+        assert cur["metadata"]["deletionTimestamp"]
+        assert cur["metadata"]["deletionGracePeriodSeconds"] == 3
+        deadline = time.monotonic() + 2.0
+        while cluster.exists("Pod", "p0", "ml"):
+            assert time.monotonic() < deadline, "reaper never fired"
+            time.sleep(0.01)
+
+    def test_no_grace_deletes_immediately(self, cluster):
+        cluster.create(make_pod("p0", "ml", "n1"))
+        cluster.delete("Pod", "p0", "ml")
+        assert not cluster.exists("Pod", "p0", "ml")
+
+    def test_repeat_graceful_delete_is_noop_force_zero_removes(self, cluster):
+        cluster.termination_grace_scale = 100.0  # reaper effectively never
+        pod = make_pod("p0", "ml", "n1")
+        pod["spec"]["terminationGracePeriodSeconds"] = 30
+        cluster.create(pod)
+        cluster.delete("Pod", "p0", "ml")
+        rv = cluster.get("Pod", "p0", "ml")["metadata"]["resourceVersion"]
+        cluster.delete("Pod", "p0", "ml")  # repeat: no-op
+        assert cluster.get("Pod", "p0", "ml")["metadata"]["resourceVersion"] == rv
+        cluster.delete("Pod", "p0", "ml", grace_period_seconds=0)  # force
+        assert not cluster.exists("Pod", "p0", "ml")
+
+    def test_finalizer_defers_removal_past_grace(self, cluster):
+        cluster.termination_grace_scale = 0.01
+        pod = make_pod("p0", "ml", "n1")
+        pod["spec"]["terminationGracePeriodSeconds"] = 1
+        pod["metadata"]["finalizers"] = ["example.com/cleanup"]
+        cluster.create(pod)
+        cluster.delete("Pod", "p0", "ml")
+        time.sleep(0.1)  # grace elapsed; finalizer still holds the object
+        cur = cluster.get("Pod", "p0", "ml")
+        assert cur["metadata"]["deletionTimestamp"]
+        cur["metadata"]["finalizers"] = []
+        cluster.update(cur)  # clearing finalizers removes it
+        assert not cluster.exists("Pod", "p0", "ml")
+
+    def test_eviction_passes_grace_through(self, cluster):
+        cluster.termination_grace_scale = 100.0
+        cluster.create(make_pod("p0", "ml", "n1"))
+        cluster.evict("p0", "ml", grace_period_seconds=30)
+        cur = cluster.get("Pod", "p0", "ml")
+        assert cur["metadata"]["deletionGracePeriodSeconds"] == 30

@@ -825,3 +825,152 @@ class TestPdbAwareEviction:
         # ...but the unhealthy one may still go
         cluster.evict("p1", "ml")
         assert not cluster.exists("Pod", "p1", "ml")
+
+
+class TestPdbSelectorSemantics:
+    """Full LabelSelector matching in the eviction registry:
+    matchExpressions and missing-selector behavior (real PDBs carry both;
+    the reference inherits these from the live apiserver)."""
+
+    def _pdb(self, cluster, selector, min_available=1):
+        return cluster.create(
+            {
+                "kind": "PodDisruptionBudget",
+                "metadata": {"name": "pdb", "namespace": "ml"},
+                "spec": {"selector": selector, "minAvailable": min_available},
+            }
+        )
+
+    def test_match_expressions_in_blocks(self, cluster):
+        from k8s_operator_libs_tpu.cluster.errors import TooManyRequestsError
+
+        cluster.create(make_pod("p0", "ml", "n0", labels={"job": "train"}))
+        self._pdb(
+            cluster,
+            {
+                "matchExpressions": [
+                    {"key": "job", "operator": "In", "values": ["train"]}
+                ]
+            },
+        )
+        with pytest.raises(TooManyRequestsError):
+            cluster.evict("p0", "ml")
+
+    def test_match_expressions_combined_with_match_labels(self, cluster):
+        from k8s_operator_libs_tpu.cluster.errors import TooManyRequestsError
+
+        cluster.create(
+            make_pod("p0", "ml", "n0", labels={"job": "train", "tier": "gold"})
+        )
+        cluster.create(
+            make_pod("p1", "ml", "n1", labels={"job": "train", "tier": "free"})
+        )
+        self._pdb(
+            cluster,
+            {
+                "matchLabels": {"job": "train"},
+                "matchExpressions": [
+                    {"key": "tier", "operator": "NotIn", "values": ["free"]}
+                ],
+            },
+        )
+        # p1 (tier=free) is outside the selector: evicts freely
+        cluster.evict("p1", "ml")
+        assert not cluster.exists("Pod", "p1", "ml")
+        # p0 is the sole protected pod: blocked
+        with pytest.raises(TooManyRequestsError):
+            cluster.evict("p0", "ml")
+
+    def test_match_expressions_exists(self, cluster):
+        from k8s_operator_libs_tpu.cluster.errors import TooManyRequestsError
+
+        cluster.create(
+            make_pod("p0", "ml", "n0", labels={"critical": "yes"})
+        )
+        self._pdb(
+            cluster,
+            {"matchExpressions": [{"key": "critical", "operator": "Exists"}]},
+        )
+        with pytest.raises(TooManyRequestsError):
+            cluster.evict("p0", "ml")
+
+    def test_missing_selector_protects_nothing(self, cluster):
+        cluster.create(make_pod("p0", "ml", "n0", labels={"job": "train"}))
+        self._pdb(cluster, None)
+        cluster.evict("p0", "ml")  # PDB without selector matches no pods
+        assert not cluster.exists("Pod", "p0", "ml")
+
+    def test_unknown_operator_fails_loudly(self, cluster):
+        from k8s_operator_libs_tpu.cluster.selectors import SelectorParseError
+
+        cluster.create(make_pod("p0", "ml", "n0", labels={"job": "train"}))
+        self._pdb(
+            cluster,
+            {
+                "matchExpressions": [
+                    {"key": "job", "operator": "Gt", "values": ["1"]}
+                ]
+            },
+        )
+        with pytest.raises(SelectorParseError):
+            cluster.evict("p0", "ml")
+        assert cluster.exists("Pod", "p0", "ml")  # protection fails CLOSED
+
+
+class TestDrainGracePeriod:
+    """DrainHelper honors grace_period_seconds end to end (the reference
+    declares it on the kubectl helper at drain_manager.go:76-96)."""
+
+    RS = {"kind": "ReplicaSet", "metadata": {"name": "rs", "namespace": "ml"}}
+
+    def test_graceful_eviction_lingers_then_completes(self, cluster):
+        cluster.termination_grace_scale = 0.02  # 1 grace-second = 20 ms
+        cluster.create(make_node("n1"))
+        pod = make_pod("w0", "ml", "n1", owner=self.RS)
+        pod["spec"]["terminationGracePeriodSeconds"] = 5
+        cluster.create(pod)
+        helper = DrainHelper(
+            cluster, DrainHelperConfig(force=True, timeout_seconds=5)
+        )
+        pods, errors = helper.get_pods_for_deletion("n1")
+        assert errors == []
+        start = time.monotonic()
+        helper.delete_or_evict_pods(pods)  # waits through the grace window
+        assert time.monotonic() - start >= 0.05
+        assert not cluster.exists("Pod", "w0", "ml")
+
+    def test_explicit_grace_overrides_pod_spec(self, cluster):
+        cluster.termination_grace_scale = 10.0  # pod's own grace = forever
+        cluster.create(make_node("n1"))
+        pod = make_pod("w0", "ml", "n1", owner=self.RS)
+        pod["spec"]["terminationGracePeriodSeconds"] = 600
+        cluster.create(pod)
+        helper = DrainHelper(
+            cluster,
+            DrainHelperConfig(
+                force=True, grace_period_seconds=0, timeout_seconds=2
+            ),
+        )
+        pods, _ = helper.get_pods_for_deletion("n1")
+        helper.delete_or_evict_pods(pods)  # grace 0 = immediate
+        assert not cluster.exists("Pod", "w0", "ml")
+
+    def test_drain_spec_grace_flows_to_helper(self, cluster, provider):
+        """DrainManager builds its helper from DrainSpec.gracePeriodSeconds."""
+        cluster.termination_grace_scale = 0.01
+        node = cluster.create(make_node("n1"))
+        pod = make_pod("w0", "ml", "n1", owner=self.RS)
+        pod["spec"]["terminationGracePeriodSeconds"] = 2
+        cluster.create(pod)
+        spec = DrainSpec(
+            enable=True, force=True, timeout_second=5, grace_period_seconds=1
+        )
+        dm = DrainManager(cluster, provider)
+        dm.schedule_nodes_drain(DrainConfiguration(spec=spec, nodes=[node]))
+        assert dm.wait_idle(5.0)
+        assert not cluster.exists("Pod", "w0", "ml")
+        state_key = util.get_upgrade_state_label_key()
+        assert (
+            cluster.get("Node", "n1")["metadata"]["labels"][state_key]
+            == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+        )

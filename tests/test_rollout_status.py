@@ -183,3 +183,32 @@ class TestStatusCli:
         )
         data = json.loads(capsys.readouterr().out)
         assert data["byState"] == {"unknown": 1}
+
+
+class TestCountInvariant:
+    def test_corrupted_state_label_counts_as_unknown(self, cluster):
+        """A node whose state label is corrupted must still satisfy
+        done + in_progress + pending + unknown == total_nodes (ADVICE r1
+        finding)."""
+        fleet = Fleet(cluster)
+        fleet.add_node("ok")
+        fleet.add_node("bad")
+        cluster.patch(
+            "Node",
+            "bad",
+            {"metadata": {"labels": {STATE_KEY_OF(): "totally-bogus"}}},
+        )
+        s = _status(cluster)
+        assert s.total_nodes == 2
+        assert s.unknown >= 1
+        assert (
+            s.done + s.in_progress + s.pending + s.unknown == s.total_nodes
+        )
+        assert s.to_dict()["unknown"] == s.unknown
+
+    def test_fresh_nodes_count_as_unknown(self, cluster):
+        fleet = Fleet(cluster)
+        fleet.add_node("fresh")
+        s = _status(cluster)
+        assert s.unknown == 1
+        assert s.done + s.in_progress + s.pending + s.unknown == 1

@@ -292,9 +292,12 @@ class TestPacing:
         assert any("can never be admitted" in r.message for r in caplog.records)
 
     def test_bypass_admissions_do_not_burn_pacing_budget(self, cluster):
-        """Regression: a manually cordoned node admitted via the throttle
-        bypass must not be stamped — it would starve the next hour's
-        budget for regular admissions."""
+        """A manually cordoned node admitted via the throttle bypass is
+        stamped (so the canary census can see it participating) but
+        carries the pacing-exempt marker — it must not starve the next
+        hour's budget for regular admissions."""
+        from k8s_operator_libs_tpu.upgrade import schedule
+
         fleet = Fleet(cluster)
         fleet.add_node("cordoned", pod_hash="rev1", unschedulable=True)
         fleet.add_node("regular", pod_hash="rev1")
@@ -309,9 +312,21 @@ class TestPacing:
         )
         _reconcile(manager, fleet, policy, cycles=2)
         key = util.get_admitted_at_annotation_key()
+        bypass_key = util.get_admitted_bypass_annotation_key()
         cordoned = cluster.get("Node", "cordoned")
-        # the bypass admission carries no stamp
-        assert key not in (cordoned["metadata"].get("annotations") or {})
+        annotations = cordoned["metadata"].get("annotations") or {}
+        # the bypass admission IS stamped (canary census visibility) ...
+        assert key in annotations
+        assert annotations.get(bypass_key) == "true"
+        # ... but pacing does not count it: the full hourly budget remains
+        nodes = cluster.list("Node")
+        assert (
+            schedule.count_recent_admissions(
+                n for n in nodes
+                if (n["metadata"].get("annotations") or {}).get(bypass_key)
+            )
+            == 0
+        )
 
 
 class TestCanary:
@@ -505,3 +520,136 @@ class TestCanary:
         assert set(fleet.states().values()) == {
             consts.UPGRADE_STATE_UPGRADE_REQUIRED
         }, "hourly budget must still be exhausted from generation 1"
+
+
+class TestCanaryBypassExposure:
+    """The canary budget caps VERSION exposure, so throttle bypasses
+    (manually cordoned nodes) consume and respect it too — blast radius
+    can never exceed canaryDomains (ADVICE r1 finding)."""
+
+    SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
+
+    def _fleet(self, cluster, slices=3, hosts=2):
+        fleet = Fleet(cluster)
+        for s in range(slices):
+            for h in range(hosts):
+                fleet.add_node(
+                    f"s{s}-h{h}",
+                    pod_hash="rev1",
+                    labels={self.SLICE_KEY: f"s{s}"},
+                )
+        fleet.publish_new_revision("rev2")
+        return fleet
+
+    def _policy(self):
+        return UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            slice_aware=True,
+            canary_domains=1,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+
+    def test_cordoned_domain_bypass_is_the_canary(self, cluster):
+        """A manually cordoned domain admitted via the throttle bypass
+        must count as THE canary: no second domain may start until it
+        succeeds."""
+        fleet = self._fleet(cluster)
+        for h in range(2):
+            cluster.patch(
+                "Node", f"s0-h{h}", {"spec": {"unschedulable": True}}
+            )
+        manager = _make_manager(cluster)
+        policy = self._policy()
+        _reconcile(manager, fleet, policy, cycles=2)
+        started = {
+            n.split("-")[0]
+            for n, s in fleet.states().items()
+            if s != consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        }
+        assert started == {"s0"}, (
+            "bypass admission must consume the canary budget; "
+            f"started={started}"
+        )
+        # and the rollout still completes once the canary succeeds
+        for _ in range(30):
+            _reconcile(manager, fleet, policy)
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+
+    def test_node_mode_cordoned_bypass_consumes_canary(self, cluster):
+        """Node-granular variant: two cordoned nodes, canary=1 — only one
+        may start."""
+        fleet = Fleet(cluster)
+        for i in range(3):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        for i in range(2):
+            cluster.patch("Node", f"n{i}", {"spec": {"unschedulable": True}})
+        manager = _make_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            canary_domains=1,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        _reconcile(manager, fleet, policy, cycles=2)
+        started = [
+            n
+            for n, s in fleet.states().items()
+            if s != consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        ]
+        assert len(started) == 1
+
+
+class TestRequestorWindowHousekeeping:
+    """A closed maintenance window gates only the NodeMaintenance
+    handoff; the upgrade-requested annotation cleanup still runs
+    (ADVICE r1 finding — reference performs it unconditionally in
+    ProcessUpgradeRequiredNodes)."""
+
+    def test_annotation_cleared_while_window_closed(
+        self, cluster, monkeypatch
+    ):
+        from k8s_operator_libs_tpu.upgrade.upgrade_requestor import (
+            RequestorNodeStateManager,
+            RequestorOptions,
+        )
+
+        fleet = Fleet(cluster)
+        req_key = util.get_upgrade_requested_annotation_key()
+        fleet.add_node("n0", pod_hash="rev1", annotations={req_key: "true"})
+        fleet.publish_new_revision("rev2")
+        manager = _make_manager(cluster)
+        opts = RequestorOptions(
+            use_maintenance_operator=True,
+            requestor_id="tpu-operator",
+            requestor_namespace="default",
+        )
+        manager.with_requestor(
+            RequestorNodeStateManager(manager.common, opts), enabled=True
+        )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            maintenance_window=MaintenanceWindowSpec(
+                start="22:00", duration_minutes=60
+            ),
+        )
+        monkeypatch.setattr(
+            schedule, "_now_utc", lambda: utc(2026, 7, 29, 12, 0)
+        )
+        _reconcile(manager, fleet, policy, cycles=3)
+        node = cluster.get("Node", "n0")
+        annotations = node["metadata"].get("annotations") or {}
+        # annotation housekeeping ran despite the closed window ...
+        assert req_key not in annotations
+        # ... but the handoff itself is gated: no CR, node still pending
+        assert cluster.list("NodeMaintenance", namespace=None) == []
+        assert (
+            fleet.node_state("n0") == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        )
