@@ -5,10 +5,14 @@ Reference parity: ``examples/apply-crds/main.go:34-61`` — a flag-driven
 wrapper over the crdutil package; consumers containerize this pattern as a
 Helm pre-install/pre-upgrade hook (pkg/crdutil/README.md:30-63).
 
-Because this environment has no live kube-apiserver, the CLI runs against
-the library's in-memory apiserver and can persist its state to a JSON file
-between invocations (``--state-file``), so apply → delete flows are
-observable across runs:
+Backends:
+
+* ``--kubeconfig [PATH]`` / ``--in-cluster`` — a REAL cluster via
+  :class:`KubeApiClient` (the reference's ctrl.GetConfig path,
+  crdutil.go:56-67); PATH defaults to $KUBECONFIG then ~/.kube/config.
+* default — the library's in-memory apiserver, optionally persisted to
+  a JSON file between invocations (``--state-file``), so apply → delete
+  flows are observable across runs without any cluster:
 
     python examples/apply_crds.py --crds-path hack/crd/bases --state-file /tmp/s.json
     python examples/apply_crds.py --crds-path hack/crd/bases --operation delete \
@@ -71,9 +75,39 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="JSON file persisting the in-memory cluster between runs",
     )
+    parser.add_argument(
+        "--kubeconfig",
+        nargs="?",
+        const="",
+        default=None,
+        help="run against a real cluster via this kubeconfig "
+        "(no value = $KUBECONFIG then ~/.kube/config)",
+    )
+    parser.add_argument(
+        "--context", default=None, help="kubeconfig context override"
+    )
+    parser.add_argument(
+        "--in-cluster",
+        action="store_true",
+        help="use the ServiceAccount-mounted in-cluster config",
+    )
     args = parser.parse_args(argv)
 
-    cluster = load_cluster(args.state_file)
+    if (args.kubeconfig is not None or args.in_cluster) and args.state_file:
+        parser.error("--state-file only applies to the in-memory backend")
+
+    if args.in_cluster:
+        from k8s_operator_libs_tpu.cluster import KubeApiClient, KubeConfig
+
+        cluster = KubeApiClient(KubeConfig.in_cluster())
+    elif args.kubeconfig is not None:
+        from k8s_operator_libs_tpu.cluster import KubeApiClient, KubeConfig
+
+        cluster = KubeApiClient(
+            KubeConfig.load(args.kubeconfig or None, context=args.context)
+        )
+    else:
+        cluster = load_cluster(args.state_file)
     config = CRDProcessorConfig(
         paths=args.crds_path,
         operation=args.operation,

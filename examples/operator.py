@@ -7,11 +7,20 @@ the controller runtime: watches on Nodes/Pods/DaemonSets feed a
 rate-limited workqueue, worker threads run the reconciler, async drain
 results land as node-label events that wake the controller back up.
 
+Run the self-contained demo (in-memory apiserver + simulated fleet):
+
     python examples/operator.py
+
+or point the SAME operator at a real cluster (no simulation; the fleet,
+DaemonSet controller and kubelets are real):
+
+    python examples/operator.py --kubeconfig ~/.kube/config \
+        --namespace tpu-ops --run-seconds 0
 """
 
 from __future__ import annotations
 
+import argparse
 import os
 import sys
 import threading
@@ -29,7 +38,92 @@ from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager, consts, ut
 from harness import DRIVER_LABELS, NAMESPACE, Fleet
 
 
+def run_real(args) -> int:
+    """Assemble the operator against a live cluster via KubeApiClient.
+    No fleet simulation: real controllers recreate driver pods."""
+    from k8s_operator_libs_tpu.cluster import KubeApiClient, KubeConfig
+    from k8s_operator_libs_tpu.controller import CrPolicySource
+
+    util.set_component_name(args.component)
+    if args.in_cluster:
+        client = KubeApiClient(KubeConfig.in_cluster())
+    else:
+        client = KubeApiClient(
+            KubeConfig.load(args.kubeconfig or None, context=args.context)
+        )
+    manager = ClusterUpgradeStateManager(client)
+    labels = {}
+    for pair in args.selector.split(","):
+        if not pair:
+            continue
+        if "=" not in pair:
+            print(
+                f"error: --selector expects k=v[,k=v...], got {pair!r}",
+                file=sys.stderr,
+            )
+            return 2
+        key, value = pair.split("=", 1)
+        labels[key] = value
+    controller = new_upgrade_controller(
+        client,
+        manager,
+        args.namespace,
+        labels,
+        policy_source=CrPolicySource(client, args.policy, args.namespace),
+        resync_seconds=args.resync_seconds,
+    )
+    controller.start(workers=1)
+    print(
+        f"operator running against {client.config.server} "
+        f"(namespace {args.namespace}, selector {args.selector}) — Ctrl-C to stop"
+    )
+    try:
+        deadline = (
+            time.monotonic() + args.run_seconds if args.run_seconds else None
+        )
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        controller.stop()
+    return 0
+
+
 def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument(
+        "--kubeconfig",
+        nargs="?",
+        const="",
+        default=None,
+        help="run against a real cluster (no value = $KUBECONFIG then "
+        "~/.kube/config); default is the in-memory demo",
+    )
+    parser.add_argument("--context", default=None)
+    parser.add_argument("--in-cluster", action="store_true")
+    parser.add_argument("--namespace", default=NAMESPACE)
+    parser.add_argument(
+        "--selector",
+        default="app=tpu-runtime",
+        help="driver DaemonSet pod labels, k=v[,k=v...]",
+    )
+    parser.add_argument("--component", default="tpu-runtime")
+    parser.add_argument("--policy", default="fleet-policy")
+    parser.add_argument("--resync-seconds", type=float, default=30.0)
+    parser.add_argument(
+        "--run-seconds",
+        type=float,
+        default=0.0,
+        help="stop after N seconds (0 = run until interrupted)",
+    )
+    args = parser.parse_args()
+    if args.kubeconfig is not None or args.in_cluster:
+        return run_real(args)
+    return run_demo()
+
+
+def run_demo() -> int:
     util.set_component_name("tpu-runtime")
     cluster = InMemoryCluster()
     fleet = Fleet(cluster, revision_hash="v1")

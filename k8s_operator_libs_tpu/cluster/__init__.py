@@ -4,7 +4,9 @@ This is layer L1 of the stack (SURVEY.md §1) — the analog of
 controller-runtime client + client-go + envtest in the reference.
 """
 
+from .apiserver import ApiServerFacade
 from .cache import InformerCache
+from .client import KIND_REGISTRY, ClusterClient, KindInfo, kind_info, register_kind
 from .errors import (
     AlreadyExistsError,
     ApiError,
@@ -19,16 +21,27 @@ from .errors import (
     is_too_many_requests,
 )
 from .inmem import InMemoryCluster, WatchEvent, merge_patch
+from .kubeclient import KubeApiClient, KubeConfig, KubeConfigError
 from .retry import retry_on_conflict
-from .selectors import labels_to_selector, matches, parse_selector
+from .selectors import labels_to_selector, match_label_selector, matches, parse_selector
 
 __all__ = [
+    "ApiServerFacade",
+    "ClusterClient",
+    "KindInfo",
+    "KIND_REGISTRY",
+    "kind_info",
+    "register_kind",
+    "KubeApiClient",
+    "KubeConfig",
+    "KubeConfigError",
     "InformerCache",
     "InMemoryCluster",
     "WatchEvent",
     "merge_patch",
     "retry_on_conflict",
     "parse_selector",
+    "match_label_selector",
     "matches",
     "labels_to_selector",
     "ApiError",

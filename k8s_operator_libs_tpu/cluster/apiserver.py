@@ -1,0 +1,321 @@
+"""ApiServerFacade — the in-memory cluster served over real HTTP.
+
+The reference's test substrate is **envtest**: a real kube-apiserver
+binary + etcd that tests talk to over HTTPS
+(upgrade_suit_test.go:87-93).  This module is the equivalent seam for
+this library: it serves :class:`~.inmem.InMemoryCluster` through an
+actual HTTP server speaking the Kubernetes REST dialect, so the
+production :class:`~.kubeclient.KubeApiClient` adapter can be exercised
+over a genuine network round trip — URL routing, JSON serialization,
+patch content types, Status error objects, watch streaming and all —
+without a kubelet or etcd.
+
+Surface (the subset this library's client uses, which is also the
+subset the reference uses):
+
+* ``GET /api/v1/...`` & ``/apis/<group>/<version>/...`` — get/list with
+  ``labelSelector`` / ``fieldSelector`` query params;
+* ``GET ...?watch=true&resourceVersion=N`` — **bounded watch**: streams
+  the journal events after N as newline-delimited JSON
+  ``{"type": ..., "object": ...}`` frames, then closes (a real
+  apiserver holds the stream open; bounded semantics keep the facade
+  synchronous — the client's journal shim re-polls);
+* ``POST`` collection — create (201; 409 AlreadyExists);
+* ``PUT`` object / ``.../status`` — update / update_status (409
+  Conflict on resourceVersion mismatch);
+* ``PATCH`` object — RFC 7386 merge patch (strategic-merge requests are
+  accepted: for the map-typed fields this library patches the two
+  coincide — PARITY.md);
+* ``DELETE`` object — optional DeleteOptions body/query
+  ``gracePeriodSeconds``;
+* ``POST .../pods/<name>/eviction`` — the Eviction subresource (429 +
+  Status reason when a PodDisruptionBudget blocks).
+
+Errors are real Kubernetes ``Status`` objects with ``reason`` set to
+NotFound / AlreadyExists / Conflict / Gone / TooManyRequests /
+BadRequest, which the client maps back onto the :mod:`~.errors`
+hierarchy.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .client import KindInfo, route_for_path
+from .errors import (
+    AlreadyExistsError,
+    ApiError,
+    BadRequestError,
+    ConflictError,
+    ExpiredError,
+    NotFoundError,
+    TooManyRequestsError,
+)
+from .inmem import InMemoryCluster, JsonObj
+
+logger = logging.getLogger(__name__)
+
+_REASONS = {
+    NotFoundError: "NotFound",
+    AlreadyExistsError: "AlreadyExists",
+    ConflictError: "Conflict",
+    BadRequestError: "BadRequest",
+    ExpiredError: "Gone",
+    TooManyRequestsError: "TooManyRequests",
+}
+
+
+def _status_body(err: ApiError) -> JsonObj:
+    return {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Failure",
+        "message": str(err),
+        "reason": _REASONS.get(type(err), "InternalError"),
+        "code": err.code,
+    }
+
+
+def _with_gvk(obj: JsonObj, info: KindInfo) -> JsonObj:
+    """Stamp apiVersion like a real apiserver response."""
+    if "apiVersion" not in obj:
+        obj["apiVersion"] = (
+            f"{info.group}/{info.version}" if info.group else info.version
+        )
+    return obj
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "ApiServerFacade/1.0"
+
+    # Set by ApiServerFacade
+    cluster: InMemoryCluster
+
+    # ------------------------------------------------------------- plumbing
+    def log_message(self, fmt: str, *args) -> None:  # noqa: A003
+        logger.debug("facade: " + fmt, *args)
+
+    def _read_body(self) -> Optional[JsonObj]:
+        length = int(self.headers.get("Content-Length") or 0)
+        if not length:
+            return None
+        raw = self.rfile.read(length)
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as err:
+            raise BadRequestError(f"invalid JSON body: {err}") from err
+
+    def _send_json(self, code: int, body: JsonObj) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_error_status(self, err: ApiError) -> None:
+        self._send_json(err.code, _status_body(err))
+
+    def _route(self):
+        parsed = urlparse(self.path)
+        route = route_for_path(parsed.path)
+        if route is None:
+            raise NotFoundError(f"no route for {parsed.path}")
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        return route, query
+
+    def _dispatch(self, method: str) -> None:
+        try:
+            (info, namespace, name, subresource), query = self._route()
+            handler = getattr(self, f"_handle_{method}")
+            handler(info, namespace, name, subresource, query)
+        except ApiError as err:
+            self._send_error_status(err)
+        except Exception as err:  # noqa: BLE001 — server boundary
+            logger.exception("facade: internal error")
+            internal = ApiError(str(err))
+            self._send_error_status(internal)
+
+    def do_GET(self) -> None:  # noqa: N802
+        self._dispatch("get")
+
+    def do_POST(self) -> None:  # noqa: N802
+        self._dispatch("post")
+
+    def do_PUT(self) -> None:  # noqa: N802
+        self._dispatch("put")
+
+    def do_PATCH(self) -> None:  # noqa: N802
+        self._dispatch("patch")
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        self._dispatch("delete")
+
+    # ------------------------------------------------------------- handlers
+    def _handle_get(self, info, namespace, name, subresource, query) -> None:
+        if name and not subresource:
+            obj = self.cluster.get(info.kind, name, namespace)
+            self._send_json(200, _with_gvk(obj, info))
+            return
+        if name:
+            raise BadRequestError(f"unsupported subresource {subresource!r}")
+        if query.get("watch") in ("true", "1"):
+            self._serve_watch(info, query)
+            return
+        items = self.cluster.list(
+            info.kind,
+            namespace=namespace if info.namespaced and namespace else None,
+            label_selector=query.get("labelSelector", ""),
+            field_selector=query.get("fieldSelector", ""),
+        )
+        body = {
+            "kind": f"{info.kind}List",
+            "apiVersion": (
+                f"{info.group}/{info.version}" if info.group else info.version
+            ),
+            "metadata": {"resourceVersion": str(self.cluster.journal_seq())},
+            "items": [_with_gvk(o, info) for o in items],
+        }
+        self._send_json(200, body)
+
+    def _serve_watch(self, info: KindInfo, query) -> None:
+        """Bounded watch: emit journal events after resourceVersion as
+        newline-delimited JSON frames, then close."""
+        try:
+            seq = int(query.get("resourceVersion") or 0)
+        except ValueError as err:
+            raise BadRequestError("resourceVersion must be an integer") from err
+        events = self.cluster.events_since(seq, kind=info.kind)
+        frames = []
+        for ev in events:
+            obj = ev.new if ev.new is not None else ev.old
+            if obj is None:
+                continue
+            type_ = {"Added": "ADDED", "Modified": "MODIFIED", "Deleted": "DELETED"}[
+                ev.type
+            ]
+            # DELETED frames carry the last object state, with the journal
+            # seq as its resourceVersion so the watcher can advance.
+            obj = dict(obj)
+            obj.setdefault("metadata", {})
+            obj["metadata"] = dict(obj["metadata"])
+            obj["metadata"]["resourceVersion"] = str(ev.seq)
+            frames.append(
+                json.dumps({"type": type_, "object": _with_gvk(obj, info)})
+            )
+        data = ("\n".join(frames) + ("\n" if frames else "")).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _handle_post(self, info, namespace, name, subresource, query) -> None:
+        body = self._read_body()
+        if body is None:
+            raise BadRequestError("POST requires a body")
+        if name and subresource == "eviction" and info.kind == "Pod":
+            delete_opts = body.get("deleteOptions") or {}
+            self.cluster.evict(
+                name,
+                namespace,
+                grace_period_seconds=delete_opts.get("gracePeriodSeconds"),
+            )
+            self._send_json(
+                201,
+                {
+                    "kind": "Status",
+                    "apiVersion": "v1",
+                    "status": "Success",
+                    "code": 201,
+                },
+            )
+            return
+        if name:
+            raise BadRequestError(f"cannot POST to object path {self.path}")
+        body.setdefault("kind", info.kind)
+        if info.namespaced and namespace:
+            body.setdefault("metadata", {}).setdefault("namespace", namespace)
+        created = self.cluster.create(body)
+        self._send_json(201, _with_gvk(created, info))
+
+    def _handle_put(self, info, namespace, name, subresource, query) -> None:
+        body = self._read_body()
+        if body is None or not name:
+            raise BadRequestError("PUT requires an object path and a body")
+        body.setdefault("kind", info.kind)
+        body.setdefault("metadata", {})["name"] = name
+        if info.namespaced and namespace:
+            body["metadata"].setdefault("namespace", namespace)
+        if subresource == "status":
+            updated = self.cluster.update_status(body)
+        elif subresource:
+            raise BadRequestError(f"unsupported subresource {subresource!r}")
+        else:
+            updated = self.cluster.update(body)
+        self._send_json(200, _with_gvk(updated, info))
+
+    def _handle_patch(self, info, namespace, name, subresource, query) -> None:
+        body = self._read_body()
+        if body is None or not name:
+            raise BadRequestError("PATCH requires an object path and a body")
+        # merge-patch and strategic-merge coincide for the map-typed
+        # fields this library patches (labels/annotations/spec scalars).
+        patched = self.cluster.patch(info.kind, name, body, namespace)
+        self._send_json(200, _with_gvk(patched, info))
+
+    def _handle_delete(self, info, namespace, name, subresource, query) -> None:
+        if not name:
+            raise BadRequestError("collection DELETE is not supported")
+        grace: Optional[int] = None
+        if "gracePeriodSeconds" in query:
+            grace = int(query["gracePeriodSeconds"])
+        body = self._read_body()
+        if body and body.get("gracePeriodSeconds") is not None:
+            grace = int(body["gracePeriodSeconds"])
+        self.cluster.delete(info.kind, name, namespace, grace_period_seconds=grace)
+        self._send_json(
+            200,
+            {"kind": "Status", "apiVersion": "v1", "status": "Success", "code": 200},
+        )
+
+
+class ApiServerFacade:
+    """Lifecycle wrapper: serve an InMemoryCluster on 127.0.0.1:<port>."""
+
+    def __init__(self, cluster: InMemoryCluster, port: int = 0) -> None:
+        self.cluster = cluster
+        handler = type("BoundHandler", (_Handler,), {"cluster": cluster})
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ApiServerFacade":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="apiserver-facade", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "ApiServerFacade":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

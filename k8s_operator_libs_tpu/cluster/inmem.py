@@ -44,10 +44,8 @@ from .errors import (
     NotFoundError,
     TooManyRequestsError,
 )
+from .client import JsonObj, Key  # canonical aliases (re-exported here)
 from .selectors import match_label_selector, parse_selector
-
-JsonObj = Dict[str, Any]
-Key = Tuple[str, str, str]  # (kind, namespace, name)
 
 _SCALARS = (str, int, float, bool, type(None))
 
@@ -547,10 +545,18 @@ class InMemoryCluster:
         with self._lock:
             return self._rv
 
-    def events_since(self, seq: int, kind: Optional[str] = None) -> List[WatchEvent]:
+    def events_since(self, seq: int, kind=None) -> List[WatchEvent]:
         """Watch events after *seq*.  Raises :class:`ExpiredError` (the 410
         Gone analog) when *seq* predates the journal's retained window, so a
-        slow watcher knows to relist instead of silently missing events."""
+        slow watcher knows to relist instead of silently missing events.
+        *kind* filters: None = all kinds, a string = one kind, or a
+        tuple/set of kind names (a controller's watched set)."""
+        if isinstance(kind, str):
+            kinds = {kind}
+        elif kind is not None:
+            kinds = set(kind)
+        else:
+            kinds = None
         with self._lock:
             if seq < self._journal_floor:
                 raise ExpiredError(
@@ -560,7 +566,10 @@ class InMemoryCluster:
                 ev
                 for ev in self._journal
                 if ev.seq > seq
-                and (kind is None or (ev.new or ev.old or {}).get("kind") == kind)
+                and (
+                    kinds is None
+                    or (ev.new or ev.old or {}).get("kind") in kinds
+                )
             ]
 
     # ----------------------------------------------------------- conveniences

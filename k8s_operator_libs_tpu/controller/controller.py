@@ -35,7 +35,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Hashable, Iterable, List, Optional, Protocol
 
 from ..cluster.errors import ExpiredError
-from ..cluster.inmem import InMemoryCluster, JsonObj, WatchEvent
+from ..cluster.client import ClusterClient
+from ..cluster.inmem import JsonObj, WatchEvent
 from .workqueue import RateLimitedQueue, ShutDown
 
 logger = logging.getLogger(__name__)
@@ -92,7 +93,7 @@ class Controller:
 
     def __init__(
         self,
-        cluster: InMemoryCluster,
+        cluster: ClusterClient,
         reconciler: Reconciler,
         *,
         name: str = "controller",
@@ -199,9 +200,22 @@ class Controller:
         # event (logged — the periodic resync covers the drift; retrying a
         # deterministic mapper bug forever would hot-loop the same error);
         # transient store errors retry next poll without losing position.
+        watched_kinds = tuple(sorted({w.kind for w in self._watches}))
         while not self._stop.is_set():
             try:
-                events = self._cluster.events_since(self._last_seq)
+                # Take the journal head BEFORE scanning: kind-filtered
+                # polls that return nothing must still advance the
+                # bookmark, else unwatched-kind churn (Lease renewals,
+                # pod writes) slides the retention window past a frozen
+                # _last_seq and every poll becomes a spurious 410 relist.
+                # Head-first ordering keeps this loss-free — events
+                # recorded after the head read are found by the next scan.
+                head = self._cluster.journal_seq()
+                # Pass the watched-kind set so HTTP backends issue one
+                # bounded watch per WATCHED kind, not per registered kind.
+                events = self._cluster.events_since(
+                    self._last_seq, kind=watched_kinds
+                )
             except ExpiredError:
                 # 410 Gone: the journal no longer holds our position —
                 # relist everything rather than silently missing events.
@@ -222,6 +236,7 @@ class Controller:
                         self.name, event.seq, err,
                     )
                 self._last_seq = max(self._last_seq, event.seq)
+            self._last_seq = max(self._last_seq, head)
             self._stop.wait(self._poll)
 
     def _fan_out(self, event: WatchEvent) -> None:

@@ -32,7 +32,8 @@ import time
 from typing import Callable, Optional
 
 from ..cluster.errors import AlreadyExistsError, ConflictError, NotFoundError
-from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.client import ClusterClient
+from ..cluster.inmem import JsonObj
 
 logger = logging.getLogger(__name__)
 
@@ -42,7 +43,7 @@ class LeaderElector:
 
     def __init__(
         self,
-        cluster: InMemoryCluster,
+        cluster: ClusterClient,
         lock_name: str,
         identity: str,
         *,

@@ -22,7 +22,8 @@ from typing import Dict, Hashable, Iterable, Optional
 
 from ..api.upgrade_spec import UpgradePolicySpec, ValidationError
 from ..cluster.errors import NotFoundError
-from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.client import ClusterClient
+from ..cluster.inmem import JsonObj
 from ..upgrade.upgrade_state import ClusterUpgradeStateManager
 from .controller import Controller, Result
 
@@ -56,7 +57,7 @@ class CrPolicySource:
     **last good** policy and logs, so a bad edit cannot yank throttling
     mid-rollout."""
 
-    cluster: InMemoryCluster
+    cluster: ClusterClient
     name: str
     namespace: str = ""
     _last_good: Optional[UpgradePolicySpec] = field(
@@ -133,7 +134,7 @@ class UpgradeReconciler:
 
 
 def new_upgrade_controller(
-    cluster: InMemoryCluster,
+    cluster: ClusterClient,
     manager: ClusterUpgradeStateManager,
     namespace: str,
     driver_labels: Dict[str, str],

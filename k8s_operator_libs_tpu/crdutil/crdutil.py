@@ -28,7 +28,7 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 import yaml
 
 from ..cluster.errors import ConflictError, NotFoundError
-from ..cluster.inmem import InMemoryCluster
+from ..cluster.client import ClusterClient
 from ..cluster.retry import retry_on_conflict
 
 CRD_KIND = "CustomResourceDefinition"
@@ -119,7 +119,7 @@ def parse_crds_from_paths(paths: Iterable[str]) -> List[Dict[str, Any]]:
 # ----------------------------------------------------------------- apply path
 
 
-def apply_crd(cluster: InMemoryCluster, crd: Dict[str, Any]) -> Dict[str, Any]:
+def apply_crd(cluster: ClusterClient, crd: Dict[str, Any]) -> Dict[str, Any]:
     """Create the CRD, or update it in place copying the live
     ResourceVersion, retrying on conflict.
 
@@ -147,7 +147,7 @@ def apply_crd(cluster: InMemoryCluster, crd: Dict[str, Any]) -> Dict[str, Any]:
     return retry_on_conflict(attempt)
 
 
-def delete_crd(cluster: InMemoryCluster, crd: Dict[str, Any]) -> bool:
+def delete_crd(cluster: ClusterClient, crd: Dict[str, Any]) -> bool:
     """Idempotent delete; returns True if the CRD existed.
 
     Reference: deleteCRDs (crdutil.go:252-272).
@@ -174,12 +174,12 @@ def crd_served_tuples(crd: Dict[str, Any]) -> List[Tuple[str, str, str]]:
     ]
 
 
-def discovery(cluster: InMemoryCluster) -> List[Tuple[str, str, str]]:
+def discovery(cluster: ClusterClient) -> List[Tuple[str, str, str]]:
     """The discovery surface: every (group, version, plural) currently
     served, i.e. belonging to an Established CRD.
 
     The in-memory apiserver establishes CRDs asynchronously (see
-    ``InMemoryCluster`` creation hooks in tests) just like a real
+    ``ClusterClient`` creation hooks in tests) just like a real
     apiserver, which is what makes this wait meaningful.
     """
     served: List[Tuple[str, str, str]] = []
@@ -195,7 +195,7 @@ def discovery(cluster: InMemoryCluster) -> List[Tuple[str, str, str]]:
 
 
 def wait_for_crds(
-    cluster: InMemoryCluster,
+    cluster: ClusterClient,
     crds: List[Dict[str, Any]],
     timeout_seconds: float = DEFAULT_READY_TIMEOUT_SECONDS,
     poll_seconds: float = DEFAULT_READY_POLL_SECONDS,
@@ -220,7 +220,7 @@ def wait_for_crds(
 
 
 def process_crds_with_config(
-    cluster: InMemoryCluster, config: CRDProcessorConfig
+    cluster: ClusterClient, config: CRDProcessorConfig
 ) -> List[Dict[str, Any]]:
     """Apply or delete every CRD found under ``config.paths``.
 
@@ -247,7 +247,7 @@ def process_crds_with_config(
 
 
 def process_crds(
-    cluster: InMemoryCluster, operation: str, *paths: str
+    cluster: ClusterClient, operation: str, *paths: str
 ) -> List[Dict[str, Any]]:
     """Convenience wrapper (reference: ProcessCRDs, crdutil.go:56-67)."""
     return process_crds_with_config(

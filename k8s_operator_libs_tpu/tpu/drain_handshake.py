@@ -31,7 +31,8 @@ from typing import Callable, Optional
 
 from ..api.upgrade_spec import PreDrainCheckpointSpec
 from ..cluster.errors import NotFoundError
-from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.client import ClusterClient
+from ..cluster.inmem import JsonObj
 from ..cluster.objects import get_annotation, name_of
 from ..upgrade import consts, util
 
@@ -47,7 +48,7 @@ class CheckpointDrainGate:
 
     def __init__(
         self,
-        cluster: InMemoryCluster,
+        cluster: ClusterClient,
         spec: Optional[PreDrainCheckpointSpec] = None,
         poll_seconds: float = DEFAULT_POLL_SECONDS,
     ) -> None:
@@ -110,7 +111,7 @@ class DrainSignalWatcher:
 
     def __init__(
         self,
-        cluster: InMemoryCluster,
+        cluster: ClusterClient,
         node_name: str,
         read_annotation: Optional[Callable[[], str]] = None,
     ) -> None:

@@ -33,7 +33,8 @@ import logging
 from typing import Dict, Iterable, List, Optional, Set
 
 from .. import metrics
-from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.client import ClusterClient
+from ..cluster.inmem import JsonObj
 from ..upgrade import util
 from ..upgrade.util import EventRecorder, log_event
 from . import topology
@@ -91,7 +92,7 @@ class SliceHealthManager:
 
     def __init__(
         self,
-        cluster: InMemoryCluster,
+        cluster: ClusterClient,
         recorder: Optional[EventRecorder] = None,
     ) -> None:
         self._cluster = cluster

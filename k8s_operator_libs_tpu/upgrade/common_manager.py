@@ -33,7 +33,8 @@ from ..api.upgrade_spec import (
     UpgradePolicySpec,
     WaitForCompletionSpec,
 )
-from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.client import ClusterClient
+from ..cluster.inmem import JsonObj
 from ..cluster.objects import (
     is_owned_by,
     name_of,
@@ -106,7 +107,7 @@ class CommonUpgradeManager:
 
     def __init__(
         self,
-        cluster: InMemoryCluster,
+        cluster: ClusterClient,
         provider: NodeUpgradeStateProvider,
         cordon_manager: CordonManager,
         drain_manager: DrainManager,

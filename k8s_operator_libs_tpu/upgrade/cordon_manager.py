@@ -10,7 +10,8 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
-from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.client import ClusterClient
+from ..cluster.inmem import JsonObj
 from ..cluster.objects import name_of, node_is_unschedulable
 from . import util
 from .util import EventRecorder, log_event
@@ -20,7 +21,7 @@ logger = logging.getLogger(__name__)
 
 class CordonManager:
     def __init__(
-        self, cluster: InMemoryCluster, recorder: Optional[EventRecorder] = None
+        self, cluster: ClusterClient, recorder: Optional[EventRecorder] = None
     ) -> None:
         self._cluster = cluster
         self._recorder = recorder

@@ -31,7 +31,8 @@ from typing import Callable, List, Optional, Protocol, Tuple
 from .. import metrics
 from ..api.upgrade_spec import DrainSpec
 from ..cluster.errors import NotFoundError, TooManyRequestsError
-from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.client import ClusterClient
+from ..cluster.inmem import JsonObj
 from ..cluster.objects import (
     name_of,
     namespace_of,
@@ -82,7 +83,7 @@ class DrainHelper:
     ``get_pods_for_deletion`` builds the plan (collecting per-pod errors),
     ``delete_or_evict_pods`` executes it and waits for termination."""
 
-    def __init__(self, cluster: InMemoryCluster, config: DrainHelperConfig) -> None:
+    def __init__(self, cluster: ClusterClient, config: DrainHelperConfig) -> None:
         self._cluster = cluster
         self._config = config
 
@@ -233,7 +234,7 @@ class DrainManager:
 
     def __init__(
         self,
-        cluster: InMemoryCluster,
+        cluster: ClusterClient,
         provider: NodeUpgradeStateProvider,
         recorder: Optional[EventRecorder] = None,
         pre_drain_gate: Optional[PreDrainGate] = None,

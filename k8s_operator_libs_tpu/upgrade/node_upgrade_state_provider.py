@@ -23,7 +23,8 @@ from typing import Iterator, List, Optional, Tuple
 from .. import metrics
 from ..cluster.cache import InformerCache
 from ..cluster.errors import NotFoundError
-from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.client import ClusterClient
+from ..cluster.inmem import JsonObj
 from . import consts, util
 from .util import EventRecorder, KeyedMutex, log_event
 
@@ -49,7 +50,7 @@ class NodeUpgradeStateProvider:
 
     def __init__(
         self,
-        cluster: InMemoryCluster,
+        cluster: ClusterClient,
         cache: InformerCache,
         recorder: Optional[EventRecorder] = None,
         cache_sync_timeout_seconds: float = DEFAULT_CACHE_SYNC_TIMEOUT_SECONDS,

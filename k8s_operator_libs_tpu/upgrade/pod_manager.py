@@ -26,7 +26,8 @@ from typing import Callable, List, Optional
 
 from ..api.upgrade_spec import PodDeletionSpec, WaitForCompletionSpec
 from ..cluster.errors import NotFoundError
-from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.client import ClusterClient
+from ..cluster.inmem import JsonObj
 from ..cluster.objects import (
     CONTROLLER_REVISION_HASH_LABEL,
     is_owned_by,
@@ -63,7 +64,7 @@ class PodManagerConfig:
 class PodManager:
     def __init__(
         self,
-        cluster: InMemoryCluster,
+        cluster: ClusterClient,
         provider: NodeUpgradeStateProvider,
         recorder: Optional[EventRecorder] = None,
         pod_deletion_filter: Optional[PodDeletionFilter] = None,

@@ -34,7 +34,8 @@ from typing import Callable, List, Optional
 
 from ..api.upgrade_spec import UpgradePolicySpec
 from ..cluster.errors import AlreadyExistsError, NotFoundError
-from ..cluster.inmem import InMemoryCluster, JsonObj, WatchEvent
+from ..cluster.client import ClusterClient
+from ..cluster.inmem import JsonObj, WatchEvent
 from ..cluster.objects import name_of
 from . import consts, schedule, util
 from .common_manager import ClusterUpgradeState, CommonUpgradeManager, NodeUpgradeState
@@ -131,7 +132,7 @@ class RequestorNodeStateManager:
                 "node maintenance upgrade mode is disabled"
             )
         self._common = common
-        self._cluster: InMemoryCluster = common._cluster
+        self._cluster: ClusterClient = common._cluster
         self.opts = opts
         self._default_spec: JsonObj = {}
         #: Optional ``hook(node) -> bool`` run in the post-maintenance

@@ -29,7 +29,8 @@ from .. import metrics
 from ..api.upgrade_spec import UpgradePolicySpec
 from ..cluster.cache import InformerCache
 from ..cluster.errors import NotFoundError
-from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.client import ClusterClient
+from ..cluster.inmem import JsonObj
 from ..cluster.selectors import labels_to_selector
 from . import consts, util
 from .common_manager import (
@@ -58,7 +59,7 @@ class ClusterUpgradeStateManager:
 
     def __init__(
         self,
-        cluster: InMemoryCluster,
+        cluster: ClusterClient,
         cache: Optional[InformerCache] = None,
         recorder: Optional[EventRecorder] = None,
         requestor: Optional[object] = None,

@@ -13,7 +13,8 @@ import logging
 import time
 from typing import Optional
 
-from ..cluster.inmem import InMemoryCluster, JsonObj
+from ..cluster.client import ClusterClient
+from ..cluster.inmem import JsonObj
 from ..cluster.objects import name_of, pod_phase
 from . import consts, util
 from .node_upgrade_state_provider import NodeUpgradeStateProvider
@@ -28,7 +29,7 @@ DEFAULT_VALIDATION_TIMEOUT_SECONDS = 600
 class ValidationManager:
     def __init__(
         self,
-        cluster: InMemoryCluster,
+        cluster: ClusterClient,
         provider: NodeUpgradeStateProvider,
         recorder: Optional[EventRecorder] = None,
         pod_selector: str = "",
