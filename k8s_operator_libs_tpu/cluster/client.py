@@ -104,6 +104,13 @@ class ClusterClient(Protocol):
         cluster, keyed (kind, namespace, name) — the InformerCache seed."""
         ...
 
+    def wait_for_seq(self, seq: int, timeout: float = 1.0) -> int:
+        """Block (≤ *timeout*) until the version sequence advances past
+        *seq*; returns the current head.  Event-driven on the in-mem
+        backend (condition variable), coarse polling over HTTP — waiters
+        in the drain/eviction paths use it instead of busy loops."""
+        ...
+
 
 @dataclass(frozen=True)
 class KindInfo:

@@ -657,6 +657,19 @@ class KubeApiClient:
             frames.append(frame)
         return frames
 
+    def wait_for_seq(self, seq: int, timeout: float = 1.0) -> int:
+        """Poll until the cluster resourceVersion advances past *seq* (or
+        timeout); returns the head.  HTTP has no push channel short of a
+        held watch stream, so this is a coarse 50 ms poll — still far
+        cheaper than per-caller 10 ms busy loops, and the same call shape
+        as the in-mem condition-variable version."""
+        deadline = time.monotonic() + timeout
+        head = self.journal_seq()
+        while head <= seq and time.monotonic() < deadline:
+            time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+            head = self.journal_seq()
+        return head
+
     # ----------------------------------------------------------- cache shim
     def snapshot(self) -> Dict[Tuple[str, str, str], JsonObj]:
         """Deep snapshot across registered kinds (InformerCache seed).

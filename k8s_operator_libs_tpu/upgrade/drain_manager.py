@@ -23,8 +23,8 @@ workload on the node save an orbax checkpoint before eviction begins.
 from __future__ import annotations
 
 import logging
-import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Protocol, Tuple
 
@@ -195,7 +195,12 @@ class DrainHelper:
             # drain doesn't hammer the store lock every 10 ms
             time.sleep(0.25)
         pending = {(namespace_of(p), name_of(p)): uid_of(p) for p in pods}
+        waiter = getattr(self._cluster, "wait_for_seq", None)
         while pending:
+            # Head BEFORE the check: a deletion landing mid-check advances
+            # the journal past `head`, so the wait below returns instantly
+            # instead of sleeping through the event.
+            head = self._cluster.journal_seq() if waiter is not None else 0
             for (ns, name), uid in list(pending.items()):
                 try:
                     current = self._cluster.get("Pod", name, ns)
@@ -210,7 +215,16 @@ class DrainHelper:
                     "drain timed out waiting for pods to terminate: "
                     + ", ".join(f"{ns}/{n}" for ns, n in pending)
                 )
-            time.sleep(0.01)
+            remaining = (
+                max(0.0, deadline - time.monotonic())
+                if deadline is not None
+                else 1.0
+            )
+            if waiter is not None:
+                # event-driven: wakes the moment ANY write lands
+                waiter(head, timeout=min(1.0, remaining))
+            else:
+                time.sleep(0.05)
 
 
 class PreDrainGate(Protocol):
@@ -227,10 +241,18 @@ class DrainConfiguration:
     nodes: List[JsonObj] = field(default_factory=list)
 
 
+#: Default bound on concurrent drain/eviction workers.  The reference
+#: spawns one goroutine per node (drain_manager.go:109-133) — free in Go,
+#: not in Python: a 4096-host wave must not mean 4096 threads.  Workers
+#: above the bound queue inside the executor; the StringSet dedup is
+#: unchanged.
+DEFAULT_WORKER_POOL_SIZE = 32
+
+
 class DrainManager:
-    """Schedules node drains on background workers (the reference's
-    goroutines); results are written via the state provider and picked up
-    by the *next* reconcile."""
+    """Schedules node drains on a BOUNDED worker pool (the reference's
+    goroutines, with a cap); results are written via the state provider
+    and picked up by the *next* reconcile."""
 
     def __init__(
         self,
@@ -239,6 +261,7 @@ class DrainManager:
         recorder: Optional[EventRecorder] = None,
         pre_drain_gate: Optional[PreDrainGate] = None,
         cordon_manager: Optional["CordonManager"] = None,
+        pool: Optional[ThreadPoolExecutor] = None,
     ) -> None:
         from .cordon_manager import CordonManager  # local: avoid import cycle
 
@@ -248,6 +271,13 @@ class DrainManager:
         self._gate = pre_drain_gate
         self._cordon_manager = cordon_manager or CordonManager(cluster, recorder)
         self._in_flight = StringSet()
+        # Shared with PodManager when assembled by ClusterUpgradeStateManager
+        # (one pool per operator, not per manager).  Threads spawn lazily,
+        # so idle managers cost nothing.
+        self._pool = pool or ThreadPoolExecutor(
+            max_workers=DEFAULT_WORKER_POOL_SIZE,
+            thread_name_prefix="drain-worker",
+        )
 
     @property
     def in_flight(self) -> StringSet:
@@ -262,10 +292,7 @@ class DrainManager:
             if not self._in_flight.add_if_absent(name):
                 logger.debug("drain already in flight for node %s", name)
                 continue
-            t = threading.Thread(
-                target=self._drain_one, args=(node, config.spec), daemon=True
-            )
-            t.start()
+            self._pool.submit(self._drain_one, node, config.spec)
 
     def wait_idle(self, timeout: float = 10.0) -> bool:
         """Test/simulation helper: wait until no drains are in flight."""
