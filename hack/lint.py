@@ -4,6 +4,9 @@
 this enforces the checks that matter most for this codebase):
 
 * every source file parses (AST);
+* no undefined names (a pyflakes-grade pass over module/function scopes —
+  added after a missing ``import time`` shipped in round 2 and the old
+  compileall gate could not see it);
 * no wildcard imports;
 * no `print(` in library code (logging/events only — the CLI, bench and
   examples are exempt);
@@ -13,6 +16,7 @@ this enforces the checks that matter most for this codebase):
 from __future__ import annotations
 
 import ast
+import builtins
 import pathlib
 import sys
 
@@ -22,14 +26,254 @@ LIB = pathlib.Path("k8s_operator_libs_tpu")
 #: (everything else must use logging/events).
 CLI_FILES = {LIB / "__main__.py"}
 
-errors: list[str] = []
-for path in sorted(LIB.rglob("*.py")):
-    text = path.read_text(encoding="utf-8")
+BUILTIN_NAMES = set(dir(builtins)) | {
+    "__file__",
+    "__name__",
+    "__doc__",
+    "__package__",
+    "__spec__",
+    "__loader__",
+    "__builtins__",
+    "__debug__",
+    "__annotations__",
+    "__dict__",
+    "__class__",
+    # typing / dataclass dunders evaluated lazily
+    "__all__",
+}
+
+
+class _Scope:
+    def __init__(self, parent: "_Scope | None", is_class: bool = False) -> None:
+        self.parent = parent
+        self.is_class = is_class
+        self.defined: set[str] = set()
+        self.globals: set[str] = set()
+
+    def lookup(self, name: str) -> bool:
+        if name in self.defined:
+            return True
+        # class scopes are skipped for enclosed lookups (Python scoping),
+        # but our checker is a linter, not an interpreter: being generous
+        # here only costs false negatives, never false positives.
+        scope = self.parent
+        while scope is not None:
+            if name in scope.defined:
+                return True
+            scope = scope.parent
+        return name in BUILTIN_NAMES
+
+
+class UndefinedNameChecker(ast.NodeVisitor):
+    """Single-pass scope walker flagging Name loads that no enclosing
+    scope binds.  Deliberately conservative: any assignment, import, arg,
+    comprehension target, with/except alias, or function/class def binds;
+    a module-level ``del`` unbinds nothing (rare, and a false negative is
+    acceptable).  String annotations and `if TYPE_CHECKING` imports are
+    treated as bindings like any other import."""
+
+    def __init__(self, path: pathlib.Path, errors: list[str]) -> None:
+        self.path = path
+        self.errors = errors
+        self.scope = _Scope(None)
+
+    # -------------------------------------------------------------- binding
+    def _bind_target(self, node: ast.AST) -> None:
+        for child in ast.walk(node):
+            if isinstance(child, ast.Name) and isinstance(
+                child.ctx, (ast.Store, ast.Del)
+            ):
+                self.scope.defined.add(child.id)
+
+    @staticmethod
+    def _walk_scope(stmt: ast.stmt):
+        """Yield nodes of *stmt* WITHOUT descending into nested
+        function/class/lambda bodies — their locals must not leak into
+        the enclosing scope (a nested ``time = 1`` would otherwise mask
+        a missing module-level ``import time``)."""
+        scope_types = (
+            ast.FunctionDef,
+            ast.AsyncFunctionDef,
+            ast.ClassDef,
+            ast.Lambda,
+        )
+        yield stmt
+        if isinstance(stmt, scope_types):
+            return  # bind only its name; its body is a new scope
+        stack = [stmt]
+        while stack:
+            node = stack.pop()
+            if node is not stmt:
+                yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, scope_types):
+                    # bind the name, skip the body (visit_* handles it)
+                    if not isinstance(child, ast.Lambda):
+                        yield child
+                    continue
+                stack.append(child)
+
+    def _prebind_body(self, body: list[ast.stmt]) -> None:
+        """Hoist every binding statement in a scope body before visiting,
+        so forward references within a module/function (helper defined
+        below its caller) do not flag."""
+        for stmt in body:
+            for node in self._walk_scope(stmt):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.scope.defined.add(node.name)
+                elif isinstance(node, ast.ClassDef):
+                    self.scope.defined.add(node.name)
+                elif isinstance(node, ast.Import):
+                    for alias in node.names:
+                        self.scope.defined.add(
+                            (alias.asname or alias.name).split(".")[0]
+                        )
+                elif isinstance(node, ast.ImportFrom):
+                    for alias in node.names:
+                        if alias.name != "*":
+                            self.scope.defined.add(alias.asname or alias.name)
+                elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        self._bind_target(t)
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    self._bind_target(node.target)
+                elif isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        if item.optional_vars is not None:
+                            self._bind_target(item.optional_vars)
+                elif isinstance(node, ast.ExceptHandler) and node.name:
+                    self.scope.defined.add(node.name)
+                elif isinstance(node, ast.Global):
+                    self.scope.defined.update(node.names)
+                elif isinstance(node, ast.Nonlocal):
+                    self.scope.defined.update(node.names)
+                elif isinstance(node, ast.NamedExpr):
+                    self._bind_target(node.target)
+                elif isinstance(node, ast.MatchAs) and node.name:
+                    self.scope.defined.add(node.name)
+                elif isinstance(node, ast.MatchStar) and node.name:
+                    self.scope.defined.add(node.name)
+                elif isinstance(node, ast.MatchMapping) and node.rest:
+                    self.scope.defined.add(node.rest)
+
+    # ------------------------------------------------------------- scoping
+    def visit_Module(self, node: ast.Module) -> None:
+        self._prebind_body(node.body)
+        self.generic_visit(node)
+
+    def _visit_function(
+        self, node: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+    ) -> None:
+        # decorators/defaults/annotations evaluate in the ENCLOSING scope
+        if not isinstance(node, ast.Lambda):
+            for dec in node.decorator_list:
+                self.visit(dec)
+            if node.returns is not None:
+                self.visit(node.returns)
+        args = node.args
+        all_args = (
+            list(args.posonlyargs)
+            + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        )
+        for default in list(args.defaults) + [
+            d for d in args.kw_defaults if d is not None
+        ]:
+            self.visit(default)
+        for arg in all_args:
+            if arg.annotation is not None:
+                self.visit(arg.annotation)
+        outer = self.scope
+        self.scope = _Scope(outer)
+        for arg in all_args:
+            self.scope.defined.add(arg.arg)
+        body = node.body if isinstance(node.body, list) else [node.body]
+        if isinstance(node.body, list):
+            self._prebind_body(body)
+            for stmt in body:
+                self.visit(stmt)
+        else:
+            self.visit(node.body)
+        self.scope = outer
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_function(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_function(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._visit_function(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        for dec in node.decorator_list:
+            self.visit(dec)
+        for base in list(node.bases) + [kw.value for kw in node.keywords]:
+            self.visit(base)
+        outer = self.scope
+        self.scope = _Scope(outer, is_class=True)
+        self._prebind_body(node.body)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.scope = outer
+
+    def _visit_comprehension(self, node: ast.AST, generators, elements) -> None:
+        # first iterable evaluates in the enclosing scope
+        self.visit(generators[0].iter)
+        outer = self.scope
+        self.scope = _Scope(outer)
+        for i, gen in enumerate(generators):
+            self._bind_target(gen.target)
+            if i > 0:
+                self.visit(gen.iter)
+            for cond in gen.ifs:
+                self.visit(cond)
+        for el in elements:
+            self.visit(el)
+        self.scope = outer
+
+    def visit_ListComp(self, node: ast.ListComp) -> None:
+        self._visit_comprehension(node, node.generators, [node.elt])
+
+    def visit_SetComp(self, node: ast.SetComp) -> None:
+        self._visit_comprehension(node, node.generators, [node.elt])
+
+    def visit_GeneratorExp(self, node: ast.GeneratorExp) -> None:
+        self._visit_comprehension(node, node.generators, [node.elt])
+
+    def visit_DictComp(self, node: ast.DictComp) -> None:
+        self._visit_comprehension(node, node.generators, [node.key, node.value])
+
+    # -------------------------------------------------------------- checks
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load) and not self.scope.lookup(node.id):
+            self.errors.append(
+                f"{self.path}:{node.lineno}: undefined name {node.id!r}"
+            )
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        pass  # string annotations stay strings — never evaluated here
+
+
+def check_file(path: pathlib.Path, errors: list[str]) -> None:
+    try:
+        text = path.read_text(encoding="utf-8")
+    except OSError as err:
+        errors.append(f"{path}: cannot read: {err}")
+        return
     try:
         tree = ast.parse(text)
     except SyntaxError as err:
         errors.append(f"{path}: syntax error: {err}")
-        continue
+        return
+    UndefinedNameChecker(path, errors).visit(tree)
     for node in ast.walk(tree):
         if isinstance(node, ast.ImportFrom) and any(
             a.name == "*" for a in node.names
@@ -49,7 +293,29 @@ for path in sorted(LIB.rglob("*.py")):
         ):
             errors.append(f"{path}:{i}: unresolved TODO/FIXME")
 
-if errors:
-    print("\n".join(errors))
-    sys.exit(1)
-print(f"lint ok ({sum(1 for _ in LIB.rglob('*.py'))} files)")
+
+def main(paths: list[str]) -> int:
+    errors: list[str] = []
+    targets = (
+        [pathlib.Path(p) for p in paths]
+        if paths
+        else sorted(LIB.rglob("*.py"))
+    )
+    count = 0
+    for path in targets:
+        if path.is_dir():
+            for sub in sorted(path.rglob("*.py")):
+                check_file(sub, errors)
+                count += 1
+        else:
+            check_file(path, errors)
+            count += 1
+    if errors:
+        print("\n".join(errors))
+        return 1
+    print(f"lint ok ({count} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

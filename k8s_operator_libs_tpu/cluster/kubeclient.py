@@ -56,6 +56,7 @@ import os
 import ssl
 import tempfile
 import threading
+import time
 from http.client import HTTPConnection, HTTPResponse, HTTPSConnection
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import quote, urlencode, urlparse
