@@ -15,10 +15,17 @@ from .errors import (
     ExpiredError,
     NotFoundError,
     TooManyRequestsError,
+    UnauthorizedError,
     is_already_exists,
     is_conflict,
     is_not_found,
     is_too_many_requests,
+)
+from .execauth import (
+    ExecCredential,
+    ExecCredentialError,
+    ExecCredentialPlugin,
+    ExecPluginSpec,
 )
 from .inmem import InMemoryCluster, WatchEvent, merge_patch
 from .kubeclient import KubeApiClient, KubeConfig, KubeConfigError
@@ -55,4 +62,9 @@ __all__ = [
     "is_already_exists",
     "TooManyRequestsError",
     "is_too_many_requests",
+    "UnauthorizedError",
+    "ExecCredential",
+    "ExecCredentialError",
+    "ExecCredentialPlugin",
+    "ExecPluginSpec",
 ]

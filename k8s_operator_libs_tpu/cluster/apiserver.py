@@ -55,12 +55,14 @@ from .errors import (
     ExpiredError,
     NotFoundError,
     TooManyRequestsError,
+    UnauthorizedError,
 )
 from .inmem import InMemoryCluster, JsonObj
 
 logger = logging.getLogger(__name__)
 
 _REASONS = {
+    UnauthorizedError: "Unauthorized",
     NotFoundError: "NotFound",
     AlreadyExistsError: "AlreadyExists",
     ConflictError: "Conflict",
@@ -96,6 +98,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     # Set by ApiServerFacade
     cluster: InMemoryCluster
+    #: When non-None, requests must carry ``Authorization: Bearer <t>``
+    #: with t in this set (tests rotate it to exercise the client's exec
+    #: credential refresh-on-401 path).  Shared mutable set — the facade
+    #: owns it.
+    accepted_tokens: Optional[set] = None
+
+    def _check_auth(self) -> None:
+        if self.accepted_tokens is None:
+            return
+        auth = self.headers.get("Authorization", "")
+        token = auth[len("Bearer "):] if auth.startswith("Bearer ") else ""
+        if token not in self.accepted_tokens:
+            raise UnauthorizedError("Unauthorized")
 
     # ------------------------------------------------------------- plumbing
     def log_message(self, fmt: str, *args) -> None:  # noqa: A003
@@ -132,6 +147,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self, method: str) -> None:
         try:
+            self._check_auth()
             (info, namespace, name, subresource), query = self._route()
             handler = getattr(self, f"_handle_{method}")
             handler(info, namespace, name, subresource, query)
@@ -289,9 +305,21 @@ class _Handler(BaseHTTPRequestHandler):
 class ApiServerFacade:
     """Lifecycle wrapper: serve an InMemoryCluster on 127.0.0.1:<port>."""
 
-    def __init__(self, cluster: InMemoryCluster, port: int = 0) -> None:
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        port: int = 0,
+        accepted_tokens: Optional[set] = None,
+    ) -> None:
         self.cluster = cluster
-        handler = type("BoundHandler", (_Handler,), {"cluster": cluster})
+        #: Mutable: tests rotate the accepted set mid-run to force 401s
+        #: (exec-plugin refresh path).  None = no auth required.
+        self.accepted_tokens = accepted_tokens
+        handler = type(
+            "BoundHandler",
+            (_Handler,),
+            {"cluster": cluster, "accepted_tokens": accepted_tokens},
+        )
         self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None

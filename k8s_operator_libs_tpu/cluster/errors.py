@@ -34,6 +34,14 @@ class BadRequestError(ApiError):
     code = 400
 
 
+class UnauthorizedError(ApiError):
+    """401 from the apiserver.  With an exec credential plugin configured
+    the client forces one refresh + retry before surfacing this (the
+    client-go exec authenticator's 401 path)."""
+
+    code = 401
+
+
 class ExpiredError(ApiError):
     """Watch window expired (the 410 Gone / ResourceExpired analog) — the
     caller must relist instead of resuming from its old sequence number."""

@@ -62,6 +62,12 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import quote, urlencode, urlparse
 
 from .client import KIND_REGISTRY, JsonObj, KindInfo, kind_info
+from .execauth import (
+    ExecCredential,
+    ExecCredentialError,
+    ExecCredentialPlugin,
+    ExecPluginSpec,
+)
 from .errors import (
     AlreadyExistsError,
     ApiError,
@@ -70,6 +76,7 @@ from .errors import (
     ExpiredError,
     NotFoundError,
     TooManyRequestsError,
+    UnauthorizedError,
 )
 from .inmem import WatchEvent, json_copy
 from .selectors import parse_selector
@@ -94,6 +101,7 @@ class KubeConfig:
         client_cert_file: Optional[str] = None,
         client_key_file: Optional[str] = None,
         insecure_skip_tls_verify: bool = False,
+        exec_plugin: Optional[ExecCredentialPlugin] = None,
     ) -> None:
         self.server = server.rstrip("/")
         self.token = token
@@ -101,6 +109,9 @@ class KubeConfig:
         self.client_cert_file = client_cert_file
         self.client_key_file = client_key_file
         self.insecure_skip_tls_verify = insecure_skip_tls_verify
+        #: GKE/EKS-style credential plugin (client-go exec authenticator
+        #: analog); consulted when no static token/cert is configured.
+        self.exec_plugin = exec_plugin
 
     # ------------------------------------------------------------- loaders
     @classmethod
@@ -137,25 +148,46 @@ class KubeConfig:
                 f"{path}: cluster {ctx.get('cluster')!r} not found"
             )
         user = users.get(ctx.get("user", ""), {})
-        # Fail loudly on credential plugins we cannot run: a GKE/EKS/OIDC
-        # kubeconfig with user.exec / auth-provider and no static
-        # credential would otherwise send unauthenticated requests and
-        # surface an opaque 401 far from the real cause.
+        # GKE/EKS kubeconfigs authenticate through a user.exec credential
+        # plugin (gke-gcloud-auth-plugin / aws eks get-token) — run it the
+        # way client-go's exec authenticator does.  The removed legacy
+        # auth-provider API stays a loud error: silently sending
+        # unauthenticated requests would surface an opaque 401 far from
+        # the real cause.
         has_static = bool(
             user.get("token")
             or user.get("client-certificate")
             or user.get("client-certificate-data")
         )
-        if not has_static and (user.get("exec") or user.get("auth-provider")):
+        exec_plugin: Optional[ExecCredentialPlugin] = None
+        if not has_static and user.get("auth-provider"):
             raise KubeConfigError(
-                f"{path}: user {ctx.get('user')!r} uses an exec/auth-provider "
-                "credential plugin, which this stdlib-only client does not "
-                "run; provide a static token or client certificate (e.g. a "
-                "ServiceAccount token) for this context"
+                f"{path}: user {ctx.get('user')!r} uses the legacy "
+                "auth-provider block, which was removed from Kubernetes; "
+                "migrate to an exec credential plugin or provide a static "
+                "token or client certificate for this context"
             )
+        if not has_static and user.get("exec"):
+            try:
+                spec = ExecPluginSpec.from_kubeconfig(user["exec"])
+                exec_plugin = ExecCredentialPlugin(
+                    spec,
+                    cluster_info={
+                        "server": cluster.get("server", ""),
+                        "certificate-authority-data": cluster.get(
+                            "certificate-authority-data"
+                        ),
+                        "insecure-skip-tls-verify": bool(
+                            cluster.get("insecure-skip-tls-verify")
+                        ),
+                    },
+                )
+            except ExecCredentialError as err:
+                raise KubeConfigError(f"{path}: {err}") from err
         # Inline base64 *-data wins over *-file paths (kubeconfig
         # precedence); data is written to temp files for the ssl APIs.
         return cls(
+            exec_plugin=exec_plugin,
             server=cluster.get("server", ""),
             token=user.get("token"),
             ca_file=(
@@ -260,16 +292,12 @@ class KubeApiClient:
         self._host = parsed.hostname or "localhost"
         self._port = parsed.port or (443 if self._scheme == "https" else 80)
         self._ssl_context: Optional[ssl.SSLContext] = None
+        #: Plugin issuance the current SSL context was built against
+        #: (exec plugins can rotate client certs; a new generation forces
+        #: a context rebuild + connection drop).
+        self._ssl_cred_generation = -1
         if self._scheme == "https":
-            ctx = ssl.create_default_context(cafile=config.ca_file)
-            if config.insecure_skip_tls_verify:
-                ctx.check_hostname = False
-                ctx.verify_mode = ssl.CERT_NONE
-            if config.client_cert_file:
-                ctx.load_cert_chain(
-                    config.client_cert_file, config.client_key_file
-                )
-            self._ssl_context = ctx
+            self._ssl_context = self._build_ssl_context(None)
         # Last-seen objects per (kind, ns, name) — synthesizes the `old`
         # side of watch events the way an informer's store does, so
         # old/new predicates (ConditionChangedPredicate) work unchanged.
@@ -285,8 +313,54 @@ class KubeApiClient:
         self.watch_timeout_seconds = 1
 
     # ------------------------------------------------------------ transport
+    def _build_ssl_context(
+        self, cred: Optional[ExecCredential]
+    ) -> ssl.SSLContext:
+        ctx = ssl.create_default_context(cafile=self.config.ca_file)
+        if self.config.insecure_skip_tls_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        # static kubeconfig client cert wins; else an exec-issued pair
+        if self.config.client_cert_file:
+            ctx.load_cert_chain(
+                self.config.client_cert_file, self.config.client_key_file
+            )
+        elif cred is not None and cred.client_cert_file:
+            ctx.load_cert_chain(cred.client_cert_file, cred.client_key_file)
+        return ctx
+
+    def _refresh_auth(
+        self, refresh_if_generation: Optional[int] = None
+    ) -> Optional[ExecCredential]:
+        """Current exec credential (None without a plugin), rebuilding the
+        TLS context + dropping pooled connections when the plugin rotates
+        a client-cert credential.  *refresh_if_generation* (the 401 path)
+        forces a plugin re-run only if no other thread has refreshed past
+        that generation already."""
+        plugin = self.config.exec_plugin
+        if plugin is None:
+            return None
+        cred = plugin.credential(
+            force_refresh=refresh_if_generation is not None,
+            observed_generation=refresh_if_generation,
+        )
+        if (
+            self._scheme == "https"
+            and cred.client_cert_file
+            and plugin.generation != self._ssl_cred_generation
+        ):
+            self._ssl_context = self._build_ssl_context(cred)
+            self._ssl_cred_generation = plugin.generation
+            self._drop_conn()
+        return cred
+
     def _conn(self):
         conn = getattr(self._local, "conn", None)
+        # Freshness feeds the replay policy: an error on a REUSED pooled
+        # connection is almost always the server having closed the idle
+        # keep-alive — safe to replay any verb once on a fresh socket
+        # (net/http's errServerClosedIdle rule, which client-go rides).
+        self._local.conn_fresh = conn is None
         if conn is None:
             if self._scheme == "https":
                 conn = HTTPSConnection(
@@ -310,13 +384,66 @@ class KubeApiClient:
             finally:
                 self._local.conn = None
 
-    def _headers(self, content_type: Optional[str] = None) -> Dict[str, str]:
+    def _headers(
+        self,
+        content_type: Optional[str] = None,
+        cred: Optional[ExecCredential] = None,
+    ) -> Dict[str, str]:
         headers = {"Accept": "application/json"}
         if content_type:
             headers["Content-Type"] = content_type
         if self.config.token:
             headers["Authorization"] = f"Bearer {self.config.token}"
+        elif cred is not None and cred.token:
+            headers["Authorization"] = f"Bearer {cred.token}"
         return headers
+
+    #: Verbs safe to replay after a connection error that may have hit
+    #: the server: GET reads; PUT carries a resourceVersion (a replayed
+    #: apply turns into 409 Conflict, not a double-write); DELETE twice
+    #: is NotFound, which every caller handles; the library's PATCHes are
+    #: merge patches of absolute label/annotation values.  POST (create,
+    #: evict) is NOT replayed — a connection dropped during getresponse
+    #: may have delivered the request, and replaying would double-create
+    #: (spurious AlreadyExists) or double-evict (PDB budget spent twice).
+    #: This matches client-go, which auto-retries idempotent verbs only.
+    _IDEMPOTENT_METHODS = frozenset({"GET", "PUT", "DELETE", "PATCH"})
+
+    def _transport(
+        self,
+        method: str,
+        path: str,
+        payload: Optional[bytes],
+        content_type: Optional[str],
+        refresh_if_generation: Optional[int] = None,
+    ) -> Tuple[HTTPResponse, bytes]:
+        """One HTTP exchange: auth, pooled-connection handling, bounded
+        retry.  A failed attempt is replayed once when (a) the verb is
+        idempotent, (b) the connection was refused (the request provably
+        never reached a server), or (c) the failure happened on a REUSED
+        pooled connection (stale keep-alive closed by the server — the
+        net/http errServerClosedIdle rule); otherwise non-idempotent
+        verbs surface the error rather than risk a double-delivery."""
+        cred = self._refresh_auth(refresh_if_generation)
+        headers = self._headers(content_type, cred)
+        for attempt in (1, 2):
+            conn = self._conn()
+            fresh = getattr(self._local, "conn_fresh", True)
+            try:
+                conn.request(method, path, body=payload, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                return resp, data
+            except (ConnectionError, ssl.SSLError, OSError) as err:
+                self._drop_conn()
+                replayable = (
+                    method in self._IDEMPOTENT_METHODS
+                    or isinstance(err, ConnectionRefusedError)
+                    or not fresh
+                )
+                if attempt == 2 or not replayable:
+                    raise
+        raise AssertionError("unreachable")
 
     def _request(
         self,
@@ -329,19 +456,20 @@ class KubeApiClient:
         if query:
             path = f"{path}?{urlencode(query)}"
         payload = json.dumps(body).encode() if body is not None else None
-        for attempt in (1, 2):  # one retry on a dead pooled connection
-            conn = self._conn()
-            try:
-                conn.request(
-                    method, path, body=payload, headers=self._headers(content_type)
-                )
-                resp = conn.getresponse()
-                data = resp.read()
-                break
-            except (ConnectionError, ssl.SSLError, OSError):
-                self._drop_conn()
-                if attempt == 2:
-                    raise
+        resp, data = self._transport(method, path, payload, content_type)
+        if resp.status == 401 and self.config.exec_plugin is not None:
+            # Server-side revocation can precede the credential's stamped
+            # expiry: force one plugin re-run and replay.  Any verb is
+            # safe — a 401 was rejected before processing.  Passing the
+            # generation the failed request used dedupes a burst of
+            # worker-thread 401s into a single plugin run.
+            resp, data = self._transport(
+                method,
+                path,
+                payload,
+                content_type,
+                refresh_if_generation=self.config.exec_plugin.generation,
+            )
         parsed: JsonObj = {}
         if data:
             try:
@@ -358,6 +486,8 @@ class KubeApiClient:
         message = status.get("message", f"HTTP {code}")
         if code == 404 or reason == "NotFound":
             return NotFoundError(message)
+        if code == 401 or reason == "Unauthorized":
+            return UnauthorizedError(message)
         if reason == "AlreadyExists":
             return AlreadyExistsError(message)
         if code == 409 or reason == "Conflict":
@@ -622,17 +752,15 @@ class KubeApiClient:
     def _request_watch(self, info: KindInfo, query: Dict[str, str]):
         """One bounded watch request → list of parsed JSON frames."""
         path = f"{info.path()}?{urlencode(query)}"
-        for attempt in (1, 2):  # one retry on a dead pooled connection
-            conn = self._conn()
-            try:
-                conn.request("GET", path, headers=self._headers())
-                resp: HTTPResponse = conn.getresponse()
-                data = resp.read()
-                break
-            except (ConnectionError, ssl.SSLError, OSError):
-                self._drop_conn()
-                if attempt == 2:
-                    raise
+        resp, data = self._transport("GET", path, None, None)
+        if resp.status == 401 and self.config.exec_plugin is not None:
+            resp, data = self._transport(
+                "GET",
+                path,
+                None,
+                None,
+                refresh_if_generation=self.config.exec_plugin.generation,
+            )
         if resp.status >= 400:
             parsed: JsonObj = {}
             try:

@@ -605,10 +605,11 @@ class TestSecondReviewRegressions:
         finally:
             controller.stop()
 
-    def test_exec_credential_kubeconfig_rejected_loudly(self, tmp_path):
+    def test_exec_credential_kubeconfig_builds_plugin(self, tmp_path):
+        """A GKE-shaped kubeconfig (user.exec, no static credential) now
+        loads with an exec plugin attached (round-2 missing #1; full
+        behavior in tests/test_execauth.py)."""
         import yaml
-
-        from k8s_operator_libs_tpu.cluster import KubeConfigError
 
         cfg = {
             "apiVersion": "v1",
@@ -634,8 +635,10 @@ class TestSecondReviewRegressions:
         }
         path = tmp_path / "kubeconfig"
         path.write_text(yaml.safe_dump(cfg))
-        with pytest.raises(KubeConfigError, match="exec/auth-provider"):
-            KubeConfig.load(str(path))
+        loaded = KubeConfig.load(str(path))
+        assert loaded.exec_plugin is not None
+        assert loaded.exec_plugin.spec.command == "gke-gcloud-auth-plugin"
+        assert loaded.token is None
 
 
 class TestDrainTerminationWaitOverHttp:
@@ -710,3 +713,71 @@ class TestDrainTerminationWaitOverHttp:
             # it genuinely waited through the grace window rather than
             # returning on a stale not-found
             assert elapsed >= 0.3
+
+
+class TestTransportRetryPolicy:
+    """ADVICE r2 #3: connection-error replay must be limited to verbs
+    that are safe to deliver twice.  POST (create/evict) is not — a
+    connection dropped after delivery would double-create/double-evict."""
+
+    def _flaky(self, client, exc, times=1):
+        orig = client._conn
+        state = {"fail": times}
+
+        class Flaky:
+            def __init__(self, inner):
+                self.__dict__["inner"] = inner
+
+            def request(self, *a, **k):
+                if state["fail"] > 0:
+                    state["fail"] -= 1
+                    raise exc
+                return self.inner.request(*a, **k)
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+        client._conn = lambda: Flaky(orig())
+        return state
+
+    def test_get_replayed_after_connection_reset(self):
+        store = InMemoryCluster()
+        store.create(make_node("n1"))
+        with ApiServerFacade(store) as facade:
+            client = KubeApiClient(KubeConfig(server=facade.url))
+            self._flaky(client, ConnectionResetError("pooled conn died"))
+            assert client.get("Node", "n1")["metadata"]["name"] == "n1"
+
+    def test_post_not_replayed_after_connection_reset(self):
+        store = InMemoryCluster()
+        with ApiServerFacade(store) as facade:
+            client = KubeApiClient(KubeConfig(server=facade.url))
+            self._flaky(client, ConnectionResetError("dropped mid-response"))
+            with pytest.raises(OSError):
+                client.create(make_node("n1"))
+
+    def test_post_replayed_after_connection_refused(self):
+        """Refused = the request provably never reached a server; any
+        verb is safe to retry."""
+        store = InMemoryCluster()
+        with ApiServerFacade(store) as facade:
+            client = KubeApiClient(KubeConfig(server=facade.url))
+            self._flaky(client, ConnectionRefusedError("nothing listening"))
+            client.create(make_node("n1"))
+            assert client.exists("Node", "n1")
+
+    def test_post_replayed_on_reused_stale_keepalive_conn(self):
+        """A POST that fails on a REUSED pooled connection (server closed
+        the idle keep-alive) is replayed once on a fresh socket — the
+        net/http errServerClosedIdle rule; only a failure on a FRESH
+        connection surfaces to the caller."""
+        store = InMemoryCluster()
+        with ApiServerFacade(store) as facade:
+            client = KubeApiClient(KubeConfig(server=facade.url))
+            client.create(make_node("warm"))  # pools a connection
+            state = self._flaky(
+                client, ConnectionResetError("idle conn closed")
+            )
+            client.create(make_node("n1"))  # replayed transparently
+            assert client.exists("Node", "n1")
+            assert state["fail"] == 0
