@@ -51,7 +51,8 @@ def run_real(args) -> int:
         client = KubeApiClient(
             KubeConfig.load(args.kubeconfig or None, context=args.context)
         )
-    manager = ClusterUpgradeStateManager(client)
+    recorder = util.ClusterEventRecorder(client, namespace=args.namespace)
+    manager = ClusterUpgradeStateManager(client, recorder=recorder)
     labels = {}
     for pair in args.selector.split(","):
         if not pair:
@@ -135,8 +136,12 @@ def run_demo() -> int:
             )
     fleet.publish_new_revision("v2")
 
+    recorder = util.ClusterEventRecorder(cluster, namespace=NAMESPACE)
     manager = ClusterUpgradeStateManager(
-        cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        cluster,
+        recorder=recorder,
+        cache_sync_timeout_seconds=2.0,
+        cache_sync_poll_seconds=0.01,
     )
     # The full CR-driven story: install the policy CRD (crdutil, the Helm
     # pre-install hook pattern), create a TpuUpgradePolicy CR, and run the

@@ -155,6 +155,7 @@ def register_kind(
 
 register_kind("Node", "", "v1", "nodes", namespaced=False)
 register_kind("Pod", "", "v1", "pods", namespaced=True)
+register_kind("Event", "", "v1", "events", namespaced=True)
 register_kind("Namespace", "", "v1", "namespaces", namespaced=False)
 register_kind("DaemonSet", "apps", "v1", "daemonsets", namespaced=True)
 register_kind(

@@ -38,6 +38,7 @@ from .upgrade_requestor import (
 )
 from .rollout_status import DomainStatus, RolloutStatus
 from .upgrade_state import ClusterUpgradeStateManager, UpgradeStateError
+from .util import ClusterEventRecorder, EventRecorder, log_event
 from .validation_manager import ValidationManager
 
 __all__ = [
@@ -70,6 +71,9 @@ __all__ = [
     "new_requestor_id_predicate",
     "ClusterUpgradeStateManager",
     "UpgradeStateError",
+    "ClusterEventRecorder",
+    "EventRecorder",
+    "log_event",
     "ValidationManager",
     "DomainStatus",
     "RolloutStatus",

@@ -14,6 +14,7 @@ Reference parity: ``pkg/upgrade/util.go`` —
 
 from __future__ import annotations
 
+import logging
 import threading
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -261,3 +262,122 @@ def log_event(
     if recorder is None:
         return
     recorder.event(obj_name, event_type, reason, message)
+
+
+class ClusterEventRecorder(EventRecorder):
+    """EventRecorder that also writes deduplicated core/v1 ``Event``
+    objects through a :class:`~..cluster.client.ClusterClient`.
+
+    The reference emits real cluster Events via controller-runtime's
+    ``record.EventRecorder`` (util.go:162-177), whose client-go correlator
+    collapses repeats of the same (object, type, reason, message) into one
+    Event with an incremented ``count`` and updated ``lastTimestamp``.
+    This recorder reproduces that contract:
+
+    * the Event name is a deterministic hash of the dedup key, so a
+      restarted operator finds its prior Event (AlreadyExists → read +
+      patch) instead of duplicating it;
+    * repeats merge-patch ``count``/``lastTimestamp`` only;
+    * cluster-write failures never break the rollout — the event is still
+      recorded in-process and the error logged (nil-safe spirit).
+
+    Events about Nodes (cluster-scoped) land in *namespace* (default
+    ``"default"``, matching kubectl's behavior for node events).
+    """
+
+    def __init__(
+        self,
+        cluster,
+        namespace: str = "default",
+        involved_kind: str = "Node",
+        source_component: Optional[str] = None,
+        capacity: int = 1000,
+    ) -> None:
+        super().__init__(capacity=capacity)
+        self._cluster = cluster
+        self._namespace = namespace
+        self._involved_kind = involved_kind
+        self._source_component = source_component
+        #: dedup key -> (event object name, last known count)
+        self._seen: Dict[tuple, tuple] = {}
+
+    @staticmethod
+    def _now() -> str:
+        import datetime as _dt
+
+        return (
+            _dt.datetime.now(_dt.timezone.utc)
+            .replace(microsecond=0)
+            .isoformat()
+            .replace("+00:00", "Z")
+        )
+
+    def event(self, obj_name: str, event_type: str, reason: str, message: str) -> None:
+        super().event(obj_name, event_type, reason, message)
+        try:
+            self._write(obj_name, event_type, reason, message)
+        except Exception:  # cluster-write failures must not break rollouts
+            logging.getLogger(__name__).warning(
+                "failed to write Event %s/%s for %s to the cluster",
+                event_type,
+                reason,
+                obj_name,
+                exc_info=True,
+            )
+
+    def _write(
+        self, obj_name: str, event_type: str, reason: str, message: str
+    ) -> None:
+        import hashlib
+
+        key = (self._involved_kind, obj_name, event_type, reason, message)
+        digest = hashlib.sha1(repr(key).encode()).hexdigest()[:16]
+        ev_name = f"{obj_name}.{digest}"
+        now = self._now()
+        with self._lock:
+            seen = self._seen.get(key)
+        if seen is None:
+            body = {
+                "kind": "Event",
+                "apiVersion": "v1",
+                "metadata": {"name": ev_name, "namespace": self._namespace},
+                "involvedObject": {
+                    "kind": self._involved_kind,
+                    "name": obj_name,
+                    "namespace": "",
+                },
+                "reason": reason,
+                "message": message,
+                "type": event_type,
+                "source": {
+                    "component": self._source_component or get_event_reason()
+                },
+                "count": 1,
+                "firstTimestamp": now,
+                "lastTimestamp": now,
+            }
+            from ..cluster.errors import AlreadyExistsError
+
+            try:
+                self._cluster.create(body)
+                count = 1
+            except AlreadyExistsError:
+                # Operator restart: adopt the prior Event.
+                existing = self._cluster.get("Event", ev_name, self._namespace)
+                count = int(existing.get("count") or 1) + 1
+                self._cluster.patch(
+                    "Event",
+                    ev_name,
+                    {"count": count, "lastTimestamp": now},
+                    self._namespace,
+                )
+        else:
+            count = seen[1] + 1
+            self._cluster.patch(
+                "Event",
+                ev_name,
+                {"count": count, "lastTimestamp": now},
+                self._namespace,
+            )
+        with self._lock:
+            self._seen[key] = (ev_name, count)

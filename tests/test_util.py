@@ -98,3 +98,76 @@ class TestEvents:
         for i in range(5):
             util.log_event(r, "n", "Normal", "r", f"m{i}")
         assert r.messages() == ["m2", "m3", "m4"]
+
+
+class TestClusterEventRecorder:
+    """Cluster-backed Events (reference: util.go:162-177 — the real
+    record.EventRecorder path consumers wire up in production)."""
+
+    def _cluster(self):
+        from k8s_operator_libs_tpu.cluster import InMemoryCluster
+
+        return InMemoryCluster()
+
+    def test_event_written_to_cluster(self):
+        cluster = self._cluster()
+        r = util.ClusterEventRecorder(cluster, namespace="ops")
+        util.log_event(r, "node-1", "Normal", "CordonRequired", "cordoning")
+        events = cluster.list("Event", namespace="ops")
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["involvedObject"] == {
+            "kind": "Node",
+            "name": "node-1",
+            "namespace": "",
+        }
+        assert ev["reason"] == "CordonRequired"
+        assert ev["type"] == "Normal"
+        assert ev["count"] == 1
+        assert ev["firstTimestamp"] and ev["lastTimestamp"]
+        # in-process record kept too (FakeRecorder contract for tests)
+        assert r.messages() == ["cordoning"]
+
+    def test_duplicate_events_dedup_by_count(self):
+        cluster = self._cluster()
+        r = util.ClusterEventRecorder(cluster)
+        for _ in range(4):
+            r.event("node-1", "Normal", "Drain", "draining")
+        events = cluster.list("Event")
+        assert len(events) == 1
+        assert events[0]["count"] == 4
+
+    def test_distinct_messages_make_distinct_events(self):
+        cluster = self._cluster()
+        r = util.ClusterEventRecorder(cluster)
+        r.event("node-1", "Normal", "Drain", "draining a")
+        r.event("node-1", "Normal", "Drain", "draining b")
+        r.event("node-2", "Normal", "Drain", "draining a")
+        assert len(cluster.list("Event")) == 3
+
+    def test_restarted_recorder_adopts_prior_event(self):
+        """Deterministic names mean an operator restart increments the
+        existing Event instead of duplicating it."""
+        cluster = self._cluster()
+        r1 = util.ClusterEventRecorder(cluster)
+        r1.event("node-1", "Warning", "DrainFailed", "timeout")
+        r2 = util.ClusterEventRecorder(cluster)  # fresh process, empty cache
+        r2.event("node-1", "Warning", "DrainFailed", "timeout")
+        events = cluster.list("Event")
+        assert len(events) == 1
+        assert events[0]["count"] == 2
+
+    def test_cluster_write_failure_does_not_raise(self):
+        class ExplodingCluster:
+            def create(self, obj):
+                raise RuntimeError("apiserver down")
+
+            def patch(self, *a, **k):
+                raise RuntimeError("apiserver down")
+
+            def get(self, *a, **k):
+                raise RuntimeError("apiserver down")
+
+        r = util.ClusterEventRecorder(ExplodingCluster())
+        r.event("node-1", "Normal", "Cordon", "msg")  # must not raise
+        assert r.messages() == ["msg"]  # in-process record survives
