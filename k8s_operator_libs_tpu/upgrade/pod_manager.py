@@ -19,8 +19,8 @@ Reference parity: ``pkg/upgrade/pod_manager.go`` (C5) —
 from __future__ import annotations
 
 import logging
-import threading
 import time
+from concurrent.futures import ThreadPoolExecutor, wait as futures_wait
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
@@ -68,12 +68,30 @@ class PodManager:
         provider: NodeUpgradeStateProvider,
         recorder: Optional[EventRecorder] = None,
         pod_deletion_filter: Optional[PodDeletionFilter] = None,
+        pool: Optional[ThreadPoolExecutor] = None,
     ) -> None:
+        from .drain_manager import DEFAULT_WORKER_POOL_SIZE
+
         self._cluster = cluster
         self._provider = provider
         self._recorder = recorder
         self._filter = pod_deletion_filter
         self._nodes_in_progress = StringSet()
+        # Shared with DrainManager when assembled by the state manager —
+        # one bounded pool per operator.  The reference spawns a goroutine
+        # per node (pod_manager.go:164-223, 275-312); a 1,000-node
+        # pod-deletion wave here queues on a few dozen threads instead.
+        self._pool = pool or ThreadPoolExecutor(
+            max_workers=DEFAULT_WORKER_POOL_SIZE,
+            thread_name_prefix="pod-worker",
+        )
+        # Completion checks are short API reads gathered synchronously by
+        # the reconcile loop; they get their own small pool so they never
+        # queue behind minutes-long drain/eviction workers sharing _pool
+        # (threads spawn lazily — an idle pool costs nothing).
+        self._check_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="pod-check"
+        )
 
     def set_pod_deletion_filter(self, pod_deletion_filter: PodDeletionFilter) -> None:
         """Install the consumer's eviction predicate (reference passes it to
@@ -140,12 +158,9 @@ class PodManager:
             if not self._nodes_in_progress.add_if_absent(name):
                 logger.debug("pods already being deleted on node %s", name)
                 continue
-            t = threading.Thread(
-                target=self._evict_one,
-                args=(node, config.deletion_spec, config.drain_enabled),
-                daemon=True,
+            self._pool.submit(
+                self._evict_one, node, config.deletion_spec, config.drain_enabled
             )
-            t.start()
 
     def _evict_one(
         self, node: JsonObj, spec: PodDeletionSpec, drain_enabled: bool
@@ -263,28 +278,42 @@ class PodManager:
         spec = config.wait_for_completion_spec
         if spec is None:
             raise PodManagerError("wait-for-completion spec required")
-        for node in config.nodes:
-            name = name_of(node)
-            pods = self._cluster.list(
-                "Pod",
-                label_selector=spec.pod_selector,
-                field_selector=f"spec.nodeName={name}",
-            )
-            running = any(self.is_pod_running_or_pending(p) for p in pods)
-            if running:
-                if spec.timeout_second != 0:
-                    self._handle_timeout_on_pod_completions(
-                        node, spec.timeout_second
-                    )
-                continue
-            # All finished: clear the start-time annotation and advance.
-            key = util.get_wait_for_pod_completion_start_time_annotation_key()
-            annotations = (node.get("metadata") or {}).get("annotations") or {}
-            if key in annotations:
-                self._provider.change_node_upgrade_annotation(
-                    node, key, consts.NULL_STRING
+        # One check per node, fanned out on the bounded pool and gathered
+        # before returning (the reference's per-node goroutines + WaitGroup,
+        # pod_manager.go:275-312) — the per-node API round trips overlap.
+        futures = [
+            self._check_pool.submit(self._check_one_node_completion, node, spec)
+            for node in config.nodes
+        ]
+        futures_wait(futures)
+        for f in futures:
+            if f.exception() is not None:
+                raise f.exception()
+
+    def _check_one_node_completion(
+        self, node: JsonObj, spec: WaitForCompletionSpec
+    ) -> None:
+        name = name_of(node)
+        pods = self._cluster.list(
+            "Pod",
+            label_selector=spec.pod_selector,
+            field_selector=f"spec.nodeName={name}",
+        )
+        running = any(self.is_pod_running_or_pending(p) for p in pods)
+        if running:
+            if spec.timeout_second != 0:
+                self._handle_timeout_on_pod_completions(
+                    node, spec.timeout_second
                 )
-            self._change_state(node, consts.UPGRADE_STATE_POD_DELETION_REQUIRED)
+            return
+        # All finished: clear the start-time annotation and advance.
+        key = util.get_wait_for_pod_completion_start_time_annotation_key()
+        annotations = (node.get("metadata") or {}).get("annotations") or {}
+        if key in annotations:
+            self._provider.change_node_upgrade_annotation(
+                node, key, consts.NULL_STRING
+            )
+        self._change_state(node, consts.UPGRADE_STATE_POD_DELETION_REQUIRED)
 
     def _handle_timeout_on_pod_completions(
         self, node: JsonObj, timeout_seconds: int

@@ -88,11 +88,28 @@ class ClusterUpgradeStateManager:
             cache_sync_poll_seconds=cache_sync_poll_seconds,
         )
         self._cordon_manager = cordon_manager or CordonManager(cluster, recorder)
+        # One bounded worker pool per operator, shared by the drain and pod
+        # managers (the reference's per-node goroutines, capped — see
+        # DEFAULT_WORKER_POOL_SIZE in drain_manager.py).
+        shared_pool = None
+        if drain_manager is None or pod_manager is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from .drain_manager import DEFAULT_WORKER_POOL_SIZE
+
+            shared_pool = ThreadPoolExecutor(
+                max_workers=DEFAULT_WORKER_POOL_SIZE,
+                thread_name_prefix="upgrade-worker",
+            )
         self._drain_manager = drain_manager or DrainManager(
-            cluster, self._provider, recorder, pre_drain_gate=pre_drain_gate
+            cluster,
+            self._provider,
+            recorder,
+            pre_drain_gate=pre_drain_gate,
+            pool=shared_pool,
         )
         self._pod_manager = pod_manager or PodManager(
-            cluster, self._provider, recorder
+            cluster, self._provider, recorder, pool=shared_pool
         )
         self._validation_manager = validation_manager or ValidationManager(
             cluster, self._provider, recorder
