@@ -8,6 +8,7 @@ from .upgrade_spec import (
     PreDrainCheckpointSpec,
     UpgradePolicySpec,
     ValidationError,
+    ValidationSpec,
     WaitForCompletionSpec,
 )
 
@@ -19,5 +20,6 @@ __all__ = [
     "PreDrainCheckpointSpec",
     "UpgradePolicySpec",
     "ValidationError",
+    "ValidationSpec",
     "WaitForCompletionSpec",
 ]

@@ -45,6 +45,16 @@ def component_name():
     yield "tpu-runtime"
 
 
+@pytest.fixture(autouse=True)
+def reset_topology_label_keys():
+    """Per-policy topology key overrides are process-global (like the
+    component name); restore defaults between tests."""
+    from k8s_operator_libs_tpu.tpu import topology
+
+    yield
+    topology.set_label_keys()
+
+
 @pytest.fixture()
 def cluster():
     return InMemoryCluster()

