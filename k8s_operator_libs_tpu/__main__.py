@@ -56,7 +56,40 @@ def cmd_status(args: argparse.Namespace) -> int:
     state = manager.build_state(
         args.namespace, _parse_selector_arg(args.selector)
     )
-    status = RolloutStatus.from_cluster_state(state)
+    policy = None
+    if args.policy:
+        from .api import UpgradePolicySpec, ValidationError
+        from .cluster.errors import NotFoundError
+
+        try:
+            cr = cluster.get("TpuUpgradePolicy", args.policy, args.namespace)
+        except NotFoundError:
+            print(
+                f"TpuUpgradePolicy {args.namespace}/{args.policy} not found "
+                f"in the dump; gates not evaluated",
+                file=sys.stderr,
+            )
+        else:
+            try:
+                policy = UpgradePolicySpec.from_dict(cr.get("spec") or {})
+                policy.validate()
+            except ValidationError as err:
+                print(
+                    f"TpuUpgradePolicy {args.namespace}/{args.policy} is "
+                    f"invalid: {err}",
+                    file=sys.stderr,
+                )
+                return 2
+    if policy is not None:
+        # The domain table and canary census must use the policy's
+        # topology keys — same push the live scheduler gets via
+        # _configure_from_policy, or status and scheduler would disagree.
+        from .tpu import topology
+
+        topology.set_label_keys(
+            policy.slice_label_keys, policy.multislice_label_keys
+        )
+    status = RolloutStatus.from_cluster_state(state, policy=policy)
     if args.json:
         print(json.dumps(status.to_dict()))
     else:
@@ -85,6 +118,13 @@ def main(argv=None) -> int:
         "--component",
         default="tpu-runtime",
         help="managed component name (parameterizes the label keys)",
+    )
+    st.add_argument(
+        "--policy",
+        default="",
+        help="TpuUpgradePolicy name in the dump; when set, the admission "
+        "gates (canary/window/pacing) are evaluated and any freeze is "
+        "explained",
     )
     st.add_argument("--json", action="store_true", help="machine output")
     st.add_argument(

@@ -36,7 +36,7 @@ from .upgrade_requestor import (
     get_requestor_opts_from_envs,
     new_requestor_id_predicate,
 )
-from .rollout_status import DomainStatus, RolloutStatus
+from .rollout_status import DomainStatus, GateStatus, RolloutStatus
 from .upgrade_state import ClusterUpgradeStateManager, UpgradeStateError
 from .util import ClusterEventRecorder, EventRecorder, log_event
 from .validation_manager import ValidationManager
@@ -76,5 +76,6 @@ __all__ = [
     "log_event",
     "ValidationManager",
     "DomainStatus",
+    "GateStatus",
     "RolloutStatus",
 ]

@@ -70,21 +70,41 @@ def window_open(spec, now: Optional[datetime] = None) -> bool:
     return False
 
 
-def count_recent_admissions(
-    nodes: Iterable[JsonObj],
-    now_ts: Optional[float] = None,
-    window_seconds: float = PACING_WINDOW_SECONDS,
-) -> int:
-    """Nodes whose admitted-at stamp lies inside the trailing window.
+def next_window_open(
+    spec, now: Optional[datetime] = None
+) -> Optional[datetime]:
+    """Earliest moment at/after *now* the window is (still) open, or None
+    when the spec can never open (defensive; a validated spec always
+    opens within a week).  Used by RolloutStatus to answer "when will
+    admissions resume?"."""
+    if now is None:
+        now = _now_utc()
+    if window_open(spec, now):
+        return now
+    hour, minute = spec.parsed_start()
+    # The next opening is some day's start time within the coming week.
+    for day_offset in range(0, 8):
+        day = now.date() + timedelta(days=day_offset)
+        if spec.days and _DAY_NAMES[day.weekday()] not in spec.days:
+            continue
+        start = datetime.combine(
+            day, dtime(hour, minute), tzinfo=timezone.utc
+        )
+        if start >= now:
+            return start
+    return None
 
-    Bypass admissions (see :func:`stamp_admission`) are excluded: their
-    domain was already disrupted, so counting them would let a burst of
-    bypasses starve the next hour's planned-admission budget."""
-    if now_ts is None:
-        now_ts = _time.time()
+
+def _recent_stamps(
+    nodes: Iterable[JsonObj], now_ts: float, window_seconds: float
+) -> list:
+    """Admitted-at timestamps inside the trailing window, bypass-exempt
+    admissions excluded — the single source of the pacing census (both
+    the budget and the next-slot time derive from it, so they can never
+    disagree on boundary/exemption semantics)."""
     key = util.get_admitted_at_annotation_key()
     bypass_key = util.get_admitted_bypass_annotation_key()
-    count = 0
+    stamps = []
     for node in nodes:
         annotations = (node.get("metadata") or {}).get("annotations") or {}
         raw = annotations.get(key)
@@ -97,8 +117,23 @@ def count_recent_admissions(
         except ValueError:
             continue
         if now_ts - ts < window_seconds:
-            count += 1
-    return count
+            stamps.append(ts)
+    return stamps
+
+
+def count_recent_admissions(
+    nodes: Iterable[JsonObj],
+    now_ts: Optional[float] = None,
+    window_seconds: float = PACING_WINDOW_SECONDS,
+) -> int:
+    """Nodes whose admitted-at stamp lies inside the trailing window.
+
+    Bypass admissions (see :func:`stamp_admission`) are excluded: their
+    domain was already disrupted, so counting them would let a burst of
+    bypasses starve the next hour's planned-admission budget."""
+    if now_ts is None:
+        now_ts = _time.time()
+    return len(_recent_stamps(nodes, now_ts, window_seconds))
 
 
 def stamp_admission(
@@ -136,3 +171,25 @@ def pacing_budget(policy, state_nodes: Iterable[JsonObj]) -> Optional[int]:
     if limit <= 0:
         return None
     return max(0, limit - count_recent_admissions(state_nodes))
+
+
+def next_pacing_slot_at(
+    nodes: Iterable[JsonObj],
+    limit: int,
+    now_ts: Optional[float] = None,
+    window_seconds: float = PACING_WINDOW_SECONDS,
+) -> Optional[float]:
+    """When the trailing-hour budget next frees a slot (unix seconds), or
+    None if a slot is already free / pacing is off.  A counted admission
+    stops counting *window_seconds* after its stamp; with ``count``
+    in-window admissions and a budget of ``limit``, the next slot opens
+    when the ``count - limit + 1``-th oldest stamp ages out."""
+    if limit <= 0:
+        return None
+    if now_ts is None:
+        now_ts = _time.time()
+    stamps = _recent_stamps(nodes, now_ts, window_seconds)
+    if len(stamps) < limit:
+        return None  # budget not exhausted
+    stamps.sort()
+    return stamps[len(stamps) - limit] + window_seconds

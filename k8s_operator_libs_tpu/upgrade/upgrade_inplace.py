@@ -22,6 +22,7 @@ host-by-host (see :mod:`..tpu.topology`).
 from __future__ import annotations
 
 import logging
+from dataclasses import dataclass
 from typing import List, Optional
 
 from ..api.upgrade_spec import UpgradePolicySpec
@@ -30,6 +31,71 @@ from . import consts, schedule, util
 from .common_manager import ClusterUpgradeState, CommonUpgradeManager, NodeUpgradeState
 
 logger = logging.getLogger(__name__)
+
+
+@dataclass
+class CanaryCensus:
+    """Point-in-time canary accounting (shared by the scheduler and
+    RolloutStatus).  A *unit* is a domain when slice_aware, else a node."""
+
+    #: Units that entered version exposure this generation (admitted-at
+    #: stamp + active/done bucket).
+    stamped: frozenset
+    #: Stamped units whose every node is upgrade-done.
+    successful: frozenset
+    #: Stamped units still mid-flight.
+    in_flight: frozenset
+    #: In-flight units with at least one node in upgrade-failed — these
+    #: are what freezes a canary.
+    failed_units: frozenset
+    #: Remaining fresh-unit admissions while the stage holds.
+    remaining: int
+    #: True once enough units succeeded: the fleet is open.
+    passed: bool
+
+
+def canary_census(
+    state: ClusterUpgradeState, policy: UpgradePolicySpec
+) -> CanaryCensus:
+    """Compute the canary stage's exposure accounting (see
+    :meth:`InplaceNodeStateManager._canary_budget` for the full
+    semantics; this is its census, extracted pure so RolloutStatus can
+    explain a frozen canary — which unit failed — without a manager)."""
+    from ..cluster.objects import get_annotation, name_of
+
+    key = util.get_admitted_at_annotation_key()
+
+    def unit_of(node):
+        if policy.slice_aware:
+            return topology.domain_of(node)
+        return "node:" + name_of(node)
+
+    current_gen_buckets = consts.ACTIVE_STATES + (consts.UPGRADE_STATE_DONE,)
+    stamped = set()
+    not_done = set()
+    failed_units = set()
+    for bucket, node_states in state.node_states.items():
+        if bucket not in consts.ALL_STATES:
+            continue
+        for ns in node_states:
+            unit = unit_of(ns.node)
+            if bucket in current_gen_buckets and get_annotation(ns.node, key):
+                stamped.add(unit)
+            if bucket != consts.UPGRADE_STATE_DONE:
+                not_done.add(unit)
+            if bucket == consts.UPGRADE_STATE_FAILED:
+                failed_units.add(unit)
+    successful = stamped - not_done
+    in_flight = stamped - successful
+    passed = len(successful) >= policy.canary_domains
+    return CanaryCensus(
+        stamped=frozenset(stamped),
+        successful=frozenset(successful),
+        in_flight=frozenset(in_flight),
+        failed_units=frozenset(in_flight & failed_units),
+        remaining=max(0, policy.canary_domains - len(stamped)),
+        passed=passed,
+    )
 
 
 class InplaceNodeStateManager:
@@ -128,48 +194,22 @@ class InplaceNodeStateManager:
         itself is never cleared — pacing's trailing-hour count must
         survive generations) and are ignored.  A participant succeeded
         when all its nodes are upgrade-done."""
-        from ..cluster.objects import get_annotation, name_of
-
-        key = util.get_admitted_at_annotation_key()
-
-        def unit_of(node):
-            if policy.slice_aware:
-                return topology.domain_of(node)
-            return "node:" + name_of(node)
-
-        current_gen_buckets = consts.ACTIVE_STATES + (
-            consts.UPGRADE_STATE_DONE,
-        )
-        stamped = set()
-        not_done = set()
-        for bucket, node_states in state.node_states.items():
-            if bucket not in consts.ALL_STATES:
-                continue
-            for ns in node_states:
-                unit = unit_of(ns.node)
-                if bucket in current_gen_buckets and get_annotation(
-                    ns.node, key
-                ):
-                    stamped.add(unit)
-                if bucket != consts.UPGRADE_STATE_DONE:
-                    not_done.add(unit)
-        successful = stamped - not_done
-        if len(successful) >= policy.canary_domains:
+        census = canary_census(state, policy)
+        if census.passed:
             return None  # canary stage passed: fleet opens up
-        remaining = max(0, policy.canary_domains - len(stamped))
         # Log only when the budget is actually holding work back — a
         # soaking canary reconciles every few seconds for hours.
-        if remaining == 0 and state.nodes_in(
+        if census.remaining == 0 and state.nodes_in(
             consts.UPGRADE_STATE_UPGRADE_REQUIRED
         ):
             logger.info(
                 "canary stage: %d/%d domains succeeded, %d in flight — "
                 "admissions frozen until the canary completes",
-                len(successful),
+                len(census.successful),
                 policy.canary_domains,
-                len(stamped) - len(successful),
+                len(census.in_flight),
             )
-        return remaining
+        return census.remaining
 
     def _quarantined_domains(
         self, state: ClusterUpgradeState, policy: UpgradePolicySpec

@@ -298,11 +298,9 @@ class ClusterUpgradeStateManager:
         if policy is not None:
             self._configure_from_policy(policy)
         else:
-            # Policy CR deleted: its topology-key overrides must not
+            # Policy CR deleted: none of its pushed overrides may
             # outlive it.
-            from ..tpu import topology
-
-            topology.set_label_keys()
+            self._restore_policy_defaults()
         common = self.common
         if policy is None or not policy.auto_upgrade:
             # Still re-publish the rollout gauges from the fresh snapshot:
@@ -343,6 +341,28 @@ class ClusterUpgradeStateManager:
             # the latency outlier the histogram must not silently drop
             metrics.observe_reconcile("apply", time.monotonic() - started)
 
+    def _restore_policy_defaults(self) -> None:
+        """Undo every policy-pushed override (topology keys, cache-sync
+        timeout, validation config) when the policy CR disappears — the
+        builder/constructor configuration is authoritative again."""
+        from ..tpu import topology
+
+        topology.set_label_keys()
+        self._provider.set_cache_sync_timeout(0)
+        self._restore_validation_baseline()
+
+    def _restore_validation_baseline(self) -> None:
+        if self._validation_baseline is None:
+            return
+        vm = self._validation_manager
+        selector, timeout, on_missing, enabled = self._validation_baseline
+        vm.pod_selector = selector
+        vm.timeout_seconds = timeout
+        vm.on_missing_pods = on_missing
+        if enabled != self._validation_enabled:
+            self._validation_enabled = enabled
+            self._common = None
+
     def _configure_from_policy(self, policy: UpgradePolicySpec) -> None:
         """Push per-policy knobs into the managers (VERDICT r2 weak #4):
         validation selector/timeout/missing-pod behavior, slice label
@@ -380,13 +400,7 @@ class ClusterUpgradeStateManager:
                     self._common = None  # rebuilt with the new phase switch
         else:
             # Validation block removed from the CR: builder wins again.
-            selector, timeout, on_missing, enabled = self._validation_baseline
-            vm.pod_selector = selector
-            vm.timeout_seconds = timeout
-            vm.on_missing_pods = on_missing
-            if enabled != self._validation_enabled:
-                self._validation_enabled = enabled
-                self._common = None
+            self._restore_validation_baseline()
         topology.set_label_keys(
             policy.slice_label_keys, policy.multislice_label_keys
         )

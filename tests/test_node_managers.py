@@ -887,6 +887,35 @@ class TestValidationPolicyKnobs:
         )
         assert mgr.provider._timeout == 9.0
 
+    def test_policy_deletion_restores_all_overrides(self, cluster):
+        """Review regression: apply_state(state, None) must undo EVERY
+        policy-pushed override — cache-sync timeout and validation
+        config, not just topology keys."""
+        from k8s_operator_libs_tpu.api import UpgradePolicySpec, ValidationSpec
+        from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+            ClusterUpgradeStateManager,
+        )
+        from k8s_operator_libs_tpu.upgrade.common_manager import (
+            ClusterUpgradeState,
+        )
+
+        mgr = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=9.0
+        ).with_validation_enabled("app=validator")
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            cache_sync_timeout_second=0.5,
+            validation=ValidationSpec(pod_selector="", timeout_second=7),
+        )
+        mgr.apply_state(ClusterUpgradeState(), policy)
+        assert mgr.provider._timeout == 0.5
+        assert mgr._validation_enabled is False
+        # CR deleted mid-rollout
+        mgr.apply_state(ClusterUpgradeState(), None)
+        assert mgr.provider._timeout == 9.0
+        assert mgr._validation_enabled is True
+        assert mgr._validation_manager.pod_selector == "app=validator"
+
     def test_apply_state_pushes_topology_label_keys(self, cluster):
         from k8s_operator_libs_tpu.api import UpgradePolicySpec
         from k8s_operator_libs_tpu.tpu import topology
