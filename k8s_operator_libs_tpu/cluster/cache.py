@@ -42,9 +42,24 @@ class InformerCache:
       advanced by journal deltas whenever it is older than the lag.
     """
 
-    def __init__(self, cluster: ClusterClient, lag_seconds: float = 0.0) -> None:
+    def __init__(
+        self,
+        cluster: ClusterClient,
+        lag_seconds: float = 0.0,
+        kinds: Optional[tuple] = None,
+    ) -> None:
+        """*kinds*: restrict the cached/watched kinds (None = every
+        registered kind).  On HTTP backends an unfiltered refresh issues
+        one bounded watch per REGISTERED kind — 10+ round trips blocking
+        the read path — so callers that know their working set (the
+        upgrade manager reads Nodes/Pods/DaemonSets/...) should pass it.
+        NOTE (HTTP backends): the watch stream is single-consumer per
+        KubeApiClient — a lagged cache sharing a client with a running
+        Controller would steal its events; give the cache its own client.
+        """
         self._cluster = cluster
         self.lag_seconds = lag_seconds
+        self._kinds = tuple(sorted(kinds)) if kinds else None
         self._lock = threading.Lock()
         self._snapshot: Dict[Key, JsonObj] = {}
         self._last_seq = 0
@@ -52,7 +67,10 @@ class InformerCache:
         #: full relists performed (observable: tests assert refreshes are
         #: incremental, ops can spot expiry churn)
         self.full_syncs = 0
-        self.sync()
+        # Pass-through mode never serves from the local view — skip the
+        # startup snapshot (a full cluster dump over HTTP, per kind).
+        if lag_seconds > 0:
+            self.sync()
 
     # ------------------------------------------------------------ refresh
     def sync(self) -> None:
@@ -62,7 +80,7 @@ class InformerCache:
         # snapshot are re-applied by the next incremental pass —
         # idempotent, loss-free (same ordering as Controller._watch_loop).
         seq = self._cluster.journal_seq()
-        snap = self._cluster.snapshot()
+        snap = self._cluster.snapshot(self._kinds)
         with self._lock:
             self._snapshot = snap
             self._last_seq = seq
@@ -73,7 +91,9 @@ class InformerCache:
         """Advance the view by journal deltas; relist on expiry."""
         try:
             head = self._cluster.journal_seq()
-            events = self._cluster.events_since(self._last_seq)
+            events = self._cluster.events_since(
+                self._last_seq, kind=self._kinds
+            )
         except ExpiredError:
             self.sync()
             return

@@ -99,9 +99,12 @@ class ClusterClient(Protocol):
     ) -> list: ...
 
     # ------------------------------------------------------------ informer
-    def snapshot(self) -> Dict[Key, JsonObj]:
+    def snapshot(
+        self, kinds: "Optional[Tuple[str, ...]]" = None
+    ) -> Dict[Key, JsonObj]:
         """Point-in-time deep copy of (a registered-kind view of) the
-        cluster, keyed (kind, namespace, name) — the InformerCache seed."""
+        cluster, keyed (kind, namespace, name) — the InformerCache seed.
+        *kinds* restricts the dump (None = every registered kind)."""
         ...
 
     def wait_for_seq(self, seq: int, timeout: float = 1.0) -> int:

@@ -596,10 +596,18 @@ class InMemoryCluster:
         with self._lock:
             return (kind, namespace, name) in self._store
 
-    def snapshot(self) -> Dict[Key, JsonObj]:
-        """Deep-copied point-in-time view of the whole store (informer sync)."""
+    def snapshot(self, kinds: Optional[tuple] = None) -> Dict[Key, JsonObj]:
+        """Deep-copied point-in-time view of the store (informer sync);
+        *kinds* restricts the view (None = everything)."""
         with self._lock:
-            return json_copy(self._store)
+            if kinds is None:
+                return json_copy(self._store)
+            wanted = set(kinds)
+            return {
+                key: json_copy(obj)
+                for key, obj in self._store.items()
+                if key[0] in wanted
+            }
 
     # ------------------------------------------------------- persistence API
     def to_dict(self) -> JsonObj:

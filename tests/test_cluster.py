@@ -23,7 +23,11 @@ from k8s_operator_libs_tpu.cluster import (
     parse_selector,
     retry_on_conflict,
 )
-from k8s_operator_libs_tpu.cluster.objects import make_node, make_pod
+from k8s_operator_libs_tpu.cluster.objects import (
+    make_daemonset,
+    make_node,
+    make_pod,
+)
 
 
 class TestSelectors:
@@ -449,3 +453,45 @@ class TestIncrementalInformer:
         # within the lag window the view must NOT include the new node
         with pytest.raises(NotFoundError):
             cache.get("Node", "late")
+
+
+class TestInformerCacheKindsFilter:
+    """ADVICE r2 medium: a cache that knows its working set must not
+    issue one bounded watch per REGISTERED kind on refresh."""
+
+    def test_refresh_passes_kinds_to_events_since(self, cluster):
+        seen = []
+        original = cluster.events_since
+
+        def spy(seq, kind=None):
+            seen.append(kind)
+            return original(seq, kind)
+
+        cluster.events_since = spy
+        cache = InformerCache(
+            cluster, lag_seconds=0.001, kinds=("Node", "Pod")
+        )
+        import time as _t
+
+        _t.sleep(0.01)
+        cluster.create(make_node("n1"))
+        _t.sleep(0.01)
+        cache.list("Node")
+        assert seen and all(k == ("Node", "Pod") for k in seen)
+
+    def test_snapshot_restricted_to_kinds(self, cluster):
+        cluster.create(make_node("n1"))
+        cluster.create(make_pod("p1", "ml", "n1"))
+        cluster.create(make_daemonset("ds", "ml"))
+        cache = InformerCache(cluster, lag_seconds=60.0, kinds=("Node",))
+        assert cache.list("Node")
+        assert cache.list("Pod") == []  # outside the working set
+        # the backend-level snapshot filter too
+        snap = cluster.snapshot(("Node",))
+        assert {k[0] for k in snap} == {"Node"}
+
+    def test_lag_zero_skips_startup_snapshot(self, cluster):
+        cluster.create(make_node("n1"))
+        cache = InformerCache(cluster, lag_seconds=0.0)
+        assert cache.full_syncs == 0  # pass-through mode: no full dump
+        assert cache.get("Node", "n1")["metadata"]["name"] == "n1"
