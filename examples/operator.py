@@ -65,18 +65,37 @@ def run_real(args) -> int:
             return 2
         key, value = pair.split("=", 1)
         labels[key] = value
-    controller = new_upgrade_controller(
-        client,
-        manager,
-        args.namespace,
-        labels,
-        policy_source=CrPolicySource(client, args.policy, args.namespace),
-        resync_seconds=args.resync_seconds,
-    )
-    controller.start(workers=1)
+
+    def make_controller():
+        return new_upgrade_controller(
+            client,
+            manager,
+            args.namespace,
+            labels,
+            policy_source=CrPolicySource(client, args.policy, args.namespace),
+            resync_seconds=args.resync_seconds,
+        )
+
+    if args.ha:
+        # Leader-elected replica (controller-runtime's LeaderElection:
+        # true): standbys idle hot until the Lease is theirs.
+        from k8s_operator_libs_tpu.controller import HaOperator
+
+        runnable = HaOperator(
+            client,
+            make_controller,
+            identity=args.identity or f"{os.uname().nodename}-{os.getpid()}",
+            lease_namespace=args.namespace,
+        )
+    else:
+        runnable = make_controller()
+        runnable = _DirectRunnable(runnable)
+    runnable.start()
     print(
         f"operator running against {client.config.server} "
-        f"(namespace {args.namespace}, selector {args.selector}) — Ctrl-C to stop"
+        f"(namespace {args.namespace}, selector {args.selector}"
+        + (", leader-elected" if args.ha else "")
+        + ") — Ctrl-C to stop"
     )
     try:
         deadline = (
@@ -87,8 +106,21 @@ def run_real(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        controller.stop()
+        runnable.stop()
     return 0
+
+
+class _DirectRunnable:
+    """Uniform start/stop shim for the non-HA single-replica path."""
+
+    def __init__(self, controller) -> None:
+        self._controller = controller
+
+    def start(self) -> None:
+        self._controller.start(workers=1)
+
+    def stop(self) -> None:
+        self._controller.stop()
 
 
 def main() -> int:
@@ -111,6 +143,17 @@ def main() -> int:
     )
     parser.add_argument("--component", default="tpu-runtime")
     parser.add_argument("--policy", default="fleet-policy")
+    parser.add_argument(
+        "--ha",
+        action="store_true",
+        help="leader-elect this replica (coordination.k8s.io Lease); run "
+        "several replicas with --ha for hot-standby failover",
+    )
+    parser.add_argument(
+        "--identity",
+        default="",
+        help="campaign identity for --ha (default: hostname-pid)",
+    )
     parser.add_argument("--resync-seconds", type=float, default=30.0)
     parser.add_argument(
         "--run-seconds",

@@ -225,6 +225,25 @@ class _Handler(BaseHTTPRequestHandler):
             frames.append(
                 json.dumps({"type": type_, "object": _with_gvk(obj, info)})
             )
+        if query.get("allowWatchBookmarks") in ("true", "1"):
+            # Closing BOOKMARK (real apiservers send one when a timed-out
+            # watch closes): the stream position at close, so quiet kinds
+            # stay fresh without borrowing another kind's RV.
+            frames.append(
+                json.dumps(
+                    {
+                        "type": "BOOKMARK",
+                        "object": {
+                            "kind": info.kind,
+                            "metadata": {
+                                "resourceVersion": str(
+                                    self.cluster.journal_seq()
+                                )
+                            },
+                        },
+                    }
+                )
+            )
         data = ("\n".join(frames) + ("\n" if frames else "")).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")

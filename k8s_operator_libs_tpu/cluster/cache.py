@@ -123,7 +123,20 @@ class InformerCache:
             self._refresh()
 
     # -------------------------------------------------------------- reads
+    def _check_kind(self, kind: str) -> None:
+        """A kinds-scoped cache must fail LOUDLY on out-of-set reads — a
+        silent empty answer for an untracked kind is the 'stale
+        emptiness' hazard the snapshot path refuses too (drains deciding
+        on data the cache was never configured to hold)."""
+        if self._kinds is not None and kind not in self._kinds:
+            raise KeyError(
+                f"kind {kind!r} is outside this InformerCache's working "
+                f"set {self._kinds}; add it to `kinds` or read the "
+                f"backend directly"
+            )
+
     def get(self, kind: str, name: str, namespace: str = "") -> JsonObj:
+        self._check_kind(kind)
         if self.lag_seconds <= 0:
             # Always-fresh cache: serve straight from the store (per-object
             # copy) instead of maintaining a local view per read.
@@ -141,6 +154,7 @@ class InformerCache:
     def list(
         self, kind: str, namespace: Optional[str] = None, label_selector: str = ""
     ) -> List[JsonObj]:
+        self._check_kind(kind)
         if self.lag_seconds <= 0:
             return self._cluster.list(kind, namespace, label_selector)
         self._maybe_refresh()

@@ -316,6 +316,13 @@ class KubeApiClient:
         # client instance (like a real informer); a second independent
         # watcher should use its own KubeApiClient.
         self._kind_bookmarks: Dict[str, int] = {}
+        #: Frames consumed by a poll that then died on a later kind's 410
+        #: — redelivered by the next events_since (bookmarks had already
+        #: advanced past them).
+        self._pending_events: list = []
+        #: Kinds whose watch 410'd: their next poll resumes from the
+        #: fresh seed-list RV, never the caller's (known-stale) cursor.
+        self._kind_reset: set = set()
         #: Server-side bound for each watch request (seconds).  Against
         #: the test facade the stream closes immediately anyway; against
         #: a real apiserver this caps how long one poll blocks.
@@ -548,15 +555,7 @@ class KubeApiClient:
         # later lists (managers relist constantly) must never advance the
         # watch position past frames the watcher hasn't consumed — only
         # delivered frames and server BOOKMARK events do that.
-        try:
-            list_rv = int(
-                (body.get("metadata") or {}).get("resourceVersion") or 0
-            )
-        except ValueError:
-            list_rv = 0
-        if list_rv:
-            with self._last_seen_lock:
-                self._kind_bookmarks.setdefault(kind, list_rv)
+        self._seed_bookmark(kind, body)
         items = body.get("items") or []
         out = []
         for item in items:
@@ -693,15 +692,23 @@ class KubeApiClient:
         the whole collection's revision regardless of page size."""
         info = kind_info("Node")
         _, body = self._request("GET", info.path(), query={"limit": "1"})
-        try:
-            rv = int((body.get("metadata") or {}).get("resourceVersion") or 0)
-        except ValueError:
-            return 0
         # This IS a Node list — its RV seeds the Node watch bookmark at
         # cursor time (first-touch only, like every list).
+        return self._seed_bookmark("Node", body)
+
+    def _seed_bookmark(self, kind: str, list_body: JsonObj) -> int:
+        """Record a collection RV as *kind*'s watch bookmark (first touch
+        only — see the seed-only rationale in :meth:`list`); returns the
+        parsed RV (0 when absent/garbled)."""
+        try:
+            rv = int(
+                (list_body.get("metadata") or {}).get("resourceVersion") or 0
+            )
+        except ValueError:
+            return 0
         if rv:
             with self._last_seen_lock:
-                self._kind_bookmarks.setdefault("Node", rv)
+                self._kind_bookmarks.setdefault(kind, rv)
         return rv
 
     def events_since(self, seq: int, kind=None) -> List[WatchEvent]:
@@ -724,7 +731,18 @@ class KubeApiClient:
             kinds = sorted(kind)
         else:
             kinds = list(KIND_REGISTRY)
-        events: List[WatchEvent] = []
+        # Start from frames consumed by a previous poll that died on a
+        # later kind's 410: their bookmarks already advanced past them,
+        # so dropping them here would lose the deltas for good.
+        with self._last_seen_lock:
+            events = [
+                e for e in self._pending_events if (e.new or e.old or {}).get("kind") in kinds
+            ]
+            self._pending_events = [
+                e
+                for e in self._pending_events
+                if (e.new or e.old or {}).get("kind") not in kinds
+            ]
         for k in kinds:
             info = KIND_REGISTRY[k]
             # Capture the bookmark BEFORE seeding: a bookmark that exists
@@ -737,14 +755,25 @@ class KubeApiClient:
                 start = self._kind_bookmarks.get(k)
             self._seed_last_seen(k)
             if start is None:
-                start = seq
+                with self._last_seen_lock:
+                    if k in self._kind_reset:
+                        # Post-410: the caller's cursor is known-stale —
+                        # resume from the fresh seed-list RV instead.
+                        start = self._kind_bookmarks.get(k, seq)
+                        self._kind_reset.discard(k)
+                    else:
+                        start = seq
             query = {
                 "watch": "true",
                 "resourceVersion": str(start),
-                # best-effort: servers MAY interleave BOOKMARK frames
-                # (kind-valid positions with no object); the primary
-                # freshness mechanism for quiet kinds is the caller-cursor
-                # advancement after each successful poll (below)
+                # BOOKMARK frames (kind-valid positions with no object)
+                # are how a quiet kind's position stays inside the
+                # server's retention window: real apiservers send one
+                # when a timed-out watch closes, and the test facade
+                # mirrors that — without them a never-changing kind would
+                # keep its seed RV until foreign-kind churn expires it
+                # into a spurious 410 relist every journal-cap's worth of
+                # writes
                 "allowWatchBookmarks": "true",
                 # bound the stream: a real apiserver holds watches open
                 # indefinitely — without this the read blocks until the
@@ -760,11 +789,16 @@ class KubeApiClient:
                 # window (410): drop the kind-local informer state so the
                 # next call re-seeds from a fresh list, then surface the
                 # 410 — callers respond by relisting (controller/cache).
+                # Frames already consumed from EARLIER kinds this call are
+                # stashed for the next poll: their bookmarks advanced past
+                # them, so raising without stashing would lose them.
                 with self._last_seen_lock:
                     self._kind_bookmarks.pop(k, None)
                     self._seeded_kinds.discard(k)
+                    self._kind_reset.add(k)
                     for key in [key for key in self._last_seen if key[0] == k]:
                         self._last_seen.pop(key)
+                    self._pending_events.extend(events)
                 raise
             # Pin the stream position even when no frames arrived: once a
             # watch is established for this kind, a later list() must not
@@ -811,18 +845,6 @@ class KubeApiClient:
                     else:
                         self._last_seen[key] = json_copy(obj)
                         events.append(WatchEvent(ev_seq, type_, old, obj))
-            # Advance a quiet kind to the caller's cursor: *seq* was read
-            # BEFORE this poll and the stream from `start` covered every
-            # event at or below it, so `seq` is a loss-free resume point —
-            # without this, a kind with no churn keeps its seed RV while
-            # other kinds churn past the server's retention window, and
-            # every poll becomes a spurious 410 full relist.  (Integer RV
-            # comparability across kinds: exact on the facade, holds on
-            # etcd's single revision domain, and self-heals via the 410
-            # reset above if a server rejects the foreign position.)
-            with self._last_seen_lock:
-                if seq > self._kind_bookmarks.get(k, start):
-                    self._kind_bookmarks[k] = seq
         events.sort(key=lambda e: e.seq)
         return [e for e in events if e.seq > seq]
 

@@ -7,6 +7,7 @@ from .controller import (
     Request,
     Result,
 )
+from .ha import DEFAULT_LOCK_NAME, HaOperator
 from .leader_election import LeaderElector
 from .upgrade_reconciler import (
     POLICY_KIND,
@@ -24,6 +25,8 @@ from .workqueue import (
 
 __all__ = [
     "Controller",
+    "DEFAULT_LOCK_NAME",
+    "HaOperator",
     "LeaderElector",
     "Reconciler",
     "Request",

@@ -485,7 +485,12 @@ class TestInformerCacheKindsFilter:
         cluster.create(make_daemonset("ds", "ml"))
         cache = InformerCache(cluster, lag_seconds=60.0, kinds=("Node",))
         assert cache.list("Node")
-        assert cache.list("Pod") == []  # outside the working set
+        # out-of-set reads fail LOUDLY (a silent [] would let drains
+        # proceed on stale emptiness)
+        with pytest.raises(KeyError):
+            cache.list("Pod")
+        with pytest.raises(KeyError):
+            cache.get("Pod", "p1", "ml")
         # the backend-level snapshot filter too
         snap = cluster.snapshot(("Node",))
         assert {k[0] for k in snap} == {"Node"}

@@ -392,3 +392,78 @@ class TestCallbackSafety:
         a.stop()  # demoted already — must STILL release the lease
         lease = cluster.get("Lease", "upgrade-operator", "kube-system")
         assert lease["spec"]["holderIdentity"] == ""
+
+
+class TestHaOperator:
+    """HaOperator assembly: controller lifecycle tied to leadership."""
+
+    class _FakeController:
+        def __init__(self):
+            self.started = 0
+            self.stopped = 0
+
+        def start(self, workers=1):
+            self.started += 1
+
+        def stop(self, timeout=10.0):
+            self.stopped += 1
+
+    def _make(self, cluster, identity, built):
+        from k8s_operator_libs_tpu.controller import HaOperator
+
+        def factory():
+            c = self._FakeController()
+            built.append(c)
+            return c
+
+        return HaOperator(
+            cluster,
+            factory,
+            identity=identity,
+            lease_duration=0.6,
+            renew_deadline=0.4,
+            retry_period=0.05,
+        )
+
+    def test_controller_starts_on_lead_stops_on_stepdown(self):
+        cluster = InMemoryCluster()
+        built = []
+        op = self._make(cluster, "a", built)
+        op.start()
+        assert wait_for(lambda: op.is_leader)
+        assert len(built) == 1 and built[0].started == 1
+        assert op.controller is built[0]
+        op.stop()
+        assert built[0].stopped == 1
+        assert op.controller is None
+
+    def test_standby_builds_nothing_until_failover(self):
+        cluster = InMemoryCluster()
+        built_a, built_b = [], []
+        op_a = self._make(cluster, "a", built_a)
+        op_a.start()
+        assert wait_for(lambda: op_a.is_leader)
+        op_b = self._make(cluster, "b", built_b)
+        op_b.start()
+        time.sleep(0.3)
+        assert built_b == []  # hot standby: no controller built
+        op_a.stop()  # clean handoff releases the lease
+        assert wait_for(lambda: op_b.is_leader, timeout=5.0)
+        assert len(built_b) == 1 and built_b[0].started == 1
+        op_b.stop()
+
+    def test_each_term_builds_a_fresh_controller(self):
+        """A stopped controller's workqueue is shut down — re-promotion
+        must build a new one, not restart the old."""
+        cluster = InMemoryCluster()
+        built = []
+        op = self._make(cluster, "a", built)
+        op.start()
+        assert wait_for(lambda: op.is_leader)
+        op.stop()
+        op2 = self._make(cluster, "a", built)
+        op2.start()
+        assert wait_for(lambda: op2.is_leader)
+        assert len(built) == 2
+        assert built[0] is not built[1]
+        op2.stop()
