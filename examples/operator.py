@@ -164,6 +164,14 @@ def main() -> int:
     args = parser.parse_args()
     if args.kubeconfig is not None or args.in_cluster:
         return run_real(args)
+    if args.ha or args.identity:
+        print(
+            "error: --ha/--identity need a real cluster "
+            "(--kubeconfig/--in-cluster); the in-memory demo runs a "
+            "single replica",
+            file=sys.stderr,
+        )
+        return 2
     return run_demo()
 
 

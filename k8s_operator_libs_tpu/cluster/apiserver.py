@@ -207,6 +207,11 @@ class _Handler(BaseHTTPRequestHandler):
             seq = int(query.get("resourceVersion") or 0)
         except ValueError as err:
             raise BadRequestError("resourceVersion must be an integer") from err
+        # Head BEFORE the scan (the Controller._watch_loop ordering): a
+        # write landing between the two reads is then past the bookmark
+        # and redelivered next poll — bookmarking a post-scan head would
+        # let the client skip it forever.
+        head = self.cluster.journal_seq()
         events = self.cluster.events_since(seq, kind=info.kind)
         frames = []
         for ev in events:
@@ -228,7 +233,12 @@ class _Handler(BaseHTTPRequestHandler):
         if query.get("allowWatchBookmarks") in ("true", "1"):
             # Closing BOOKMARK (real apiservers send one when a timed-out
             # watch closes): the stream position at close, so quiet kinds
-            # stay fresh without borrowing another kind's RV.
+            # stay fresh without borrowing another kind's RV.  Position =
+            # the pre-scan head or the last delivered frame, whichever is
+            # later — both are covered by this response.
+            position = max(
+                [head] + [ev.seq for ev in events]
+            )
             frames.append(
                 json.dumps(
                     {
@@ -236,9 +246,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "object": {
                             "kind": info.kind,
                             "metadata": {
-                                "resourceVersion": str(
-                                    self.cluster.journal_seq()
-                                )
+                                "resourceVersion": str(position)
                             },
                         },
                     }

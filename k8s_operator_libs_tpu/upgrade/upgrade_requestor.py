@@ -228,15 +228,27 @@ class RequestorNodeStateManager:
         :320-368).  A ConflictError propagates; the caller's next reconcile
         retries with fresh state."""
         nm = node_state.node_maintenance
-        shared_mode = (
-            nm is not None
-            and self.opts.node_maintenance_name_prefix
+        shared_prefix = (
+            self.opts.node_maintenance_name_prefix
             == DEFAULT_NODE_MAINTENANCE_NAME_PREFIX
         )
-        if not shared_mode:
+        if nm is None:
+            self.create_node_maintenance(node_state)
+            nm = node_state.node_maintenance
+            if nm is None:
+                return
+            if (nm.get("spec") or {}).get("requestorID") == self.opts.requestor_id:
+                return  # we created (or already owned) it
+            if not shared_prefix:
+                return  # custom prefix: no membership protocol
+            # Lost the create race: another operator's CR appeared between
+            # our snapshot and the create.  Fall through and JOIN it —
+            # adopting without membership would let the owner delete the
+            # CR out from under us mid-flow (recoverable via the
+            # missing-CR path, but a needless restart of the admission).
+        elif not shared_prefix:
             self.create_node_maintenance(node_state)
             return
-        assert nm is not None
         spec = nm.get("spec") or {}
         if spec.get("requestorID") == self.opts.requestor_id:
             return  # already owned by us

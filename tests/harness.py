@@ -154,9 +154,19 @@ class FakeMaintenanceOperator:
     (reference: Mellanox maintenance-operator; conditions consumed at
     upgrade_requestor.go:416-452)."""
 
-    def __init__(self, cluster: InMemoryCluster, namespace: str = "default"):
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        namespace: str = "default",
+        ready_delay_seconds: float = 0.0,
+    ) -> None:
         self.cluster = cluster
         self.namespace = namespace
+        #: Minimum CR age before Ready is reported — real maintenance
+        #: (cordon + drain) takes time; a nonzero delay keeps CRs open
+        #: long enough for shared-requestor appends to overlap.
+        self.ready_delay_seconds = ready_delay_seconds
+        self._first_seen: Dict[str, float] = {}
 
     FINALIZER = "maintenance.tpu.google.com/finalizer"
 
@@ -177,6 +187,12 @@ class FakeMaintenanceOperator:
             conds = (nm.get("status") or {}).get("conditions") or []
             if any(c.get("type") == "Ready" for c in conds):
                 continue
+            if self.ready_delay_seconds > 0:
+                first = self._first_seen.setdefault(
+                    nm["metadata"]["name"], time.monotonic()
+                )
+                if time.monotonic() - first < self.ready_delay_seconds:
+                    continue  # maintenance still "in progress"
             if self.FINALIZER not in (nm["metadata"].get("finalizers") or []):
                 nm["metadata"].setdefault("finalizers", []).append(self.FINALIZER)
             node_name = (nm.get("spec") or {}).get("nodeName", "")

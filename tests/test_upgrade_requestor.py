@@ -183,6 +183,34 @@ class TestSharedRequestorProtocol:
         assert nm["spec"]["requestorID"] == "operator-a"
         assert nm["spec"]["additionalRequestors"] == ["operator-b"]
 
+    def test_lost_create_race_joins_membership(self, cluster, fleet):
+        """Review regression (two-operator e2e): when another operator's
+        CR appears between our snapshot and our create, the AlreadyExists
+        adoption must JOIN additionalRequestors — piggybacking without
+        membership lets the owner delete the CR out from under us."""
+        fleet.add_node("n1", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager, requestor = make_requestor_manager(
+            cluster, requestor_id="operator-b"
+        )
+        policy = UpgradePolicySpec(auto_upgrade=True)
+        reconcile(manager, fleet, policy)  # classify -> upgrade-required
+        # operator-a's CR lands AFTER our snapshot would attach it: create
+        # it via a transition listener right before our create runs — the
+        # snapshot for the next reconcile is taken first, so
+        # node_maintenance is None and the create path races and loses.
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        ns = state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED)[0]
+        assert ns.node_maintenance is None
+        self._nm(cluster, owner="operator-a")  # the race winner
+        manager.apply_state(state, policy)
+        nm = requestor.get_node_maintenance_obj("n1")
+        assert nm["spec"]["requestorID"] == "operator-a"
+        assert nm["spec"]["additionalRequestors"] == ["operator-b"]
+        assert fleet.node_state("n1") == (
+            consts.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED
+        )
+
     def test_append_is_idempotent(self, cluster, fleet):
         fleet.add_node("n1", pod_hash="rev1")
         fleet.publish_new_revision("rev2")
