@@ -108,6 +108,7 @@ class InMemoryCluster:
         self,
         crd_establish_delay_seconds: float = 0.0,
         termination_grace_scale: float = 1.0,
+        use_indexes: bool = True,
     ) -> None:
         self._lock = threading.RLock()
         #: Signaled on every journal append — the push half of
@@ -133,6 +134,9 @@ class InMemoryCluster:
         # scans the whole store under the lock — O(fleet²) per wave.
         self._by_kind: Dict[str, set] = {}
         self._pods_by_node: Dict[str, set] = {}
+        #: Bench A/B toggle: False forces every list into a full-store
+        #: scan (the round-1 behavior) so the index win is measurable.
+        self._use_indexes = use_indexes
 
     # ------------------------------------------------------------------ util
     def _next_rv(self) -> str:
@@ -244,6 +248,7 @@ class InMemoryCluster:
             # field filters then run on the stored objects FIRST, so only
             # matches are copied (copying under the store lock is what
             # serializes concurrent readers at fleet scale).
+            node_filter = None
             if field_selector:
                 if kind != "Pod" or not field_selector.startswith(
                     "spec.nodeName="
@@ -254,9 +259,15 @@ class InMemoryCluster:
                         f"indexed)"
                     )
                 node = field_selector.split("=", 1)[1]
-                keys = self._pods_by_node.get(node) or ()
-            else:
+                if self._use_indexes:
+                    keys = self._pods_by_node.get(node) or ()
+                else:
+                    node_filter = node
+                    keys = [k for k in self._store if k[0] == kind]
+            elif self._use_indexes:
                 keys = self._by_kind.get(kind) or ()
+            else:
+                keys = [k for k in self._store if k[0] == kind]
             matches = []
             for key in keys:
                 obj = self._store.get(key)
@@ -264,6 +275,10 @@ class InMemoryCluster:
                     continue
                 _, ns, _name = key
                 if namespace is not None and ns != namespace:
+                    continue
+                if node_filter is not None and (
+                    (obj.get("spec") or {}).get("nodeName") or ""
+                ) != node_filter:
                     continue
                 labels = (obj.get("metadata") or {}).get("labels") or {}
                 if not match(labels):

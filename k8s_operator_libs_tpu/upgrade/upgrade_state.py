@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import logging
 import time
+from contextlib import nullcontext
 from typing import Dict, List, Optional, Tuple
 
 from .. import metrics
@@ -66,6 +67,7 @@ class ClusterUpgradeStateManager:
         use_maintenance_operator: bool = False,
         pre_drain_gate: Optional[PreDrainGate] = None,
         cascade: bool = False,
+        deferred_visibility: bool = True,
         cache_sync_timeout_seconds: float = 10.0,
         cache_sync_poll_seconds: float = 1.0,
         # test injection points (the reference wires mocks the same way,
@@ -118,6 +120,11 @@ class ClusterUpgradeStateManager:
             self._provider
         )
         self._cascade = cascade
+        #: Bench A/B toggle: False pays the cache-visibility wait per
+        #: write (the reference's per-write pattern,
+        #: node_upgrade_state_provider.go:100-117) instead of one
+        #: amortized barrier per reconcile.
+        self._deferred_visibility = deferred_visibility
         self._pod_deletion_enabled = False
         self._validation_enabled = False
         #: Builder-configured validation settings, snapshotted before the
@@ -494,7 +501,12 @@ class ClusterUpgradeStateManager:
             # 11. uncordon (both modes' processors run — reference :311-325)
             lambda: self._process_uncordon_required_nodes_wrapper(state),
         ]
-        with self._provider.deferred_visibility():
+        barrier = (
+            self._provider.deferred_visibility()
+            if self._deferred_visibility
+            else nullcontext()
+        )
+        with barrier:
             if not self._cascade:
                 for phase in phases:
                     phase()

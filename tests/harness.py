@@ -174,7 +174,13 @@ class FakeMaintenanceOperator:
         from k8s_operator_libs_tpu.cluster.errors import NotFoundError
 
         handled = 0
-        for nm in self.cluster.list("NodeMaintenance", namespace=self.namespace):
+        crs = self.cluster.list("NodeMaintenance", namespace=self.namespace)
+        # Prune first-seen stamps of vanished CRs: a deleted-and-recreated
+        # same-name CR must serve a fresh ready_delay window.
+        live = {nm["metadata"]["name"] for nm in crs}
+        for name in [n for n in self._first_seen if n not in live]:
+            del self._first_seen[name]
+        for nm in crs:
             # Graceful-deletion arbitration: the requestor's delete is only a
             # *request* (upgrade_requestor.go:241-246 "assuming maintenance OP
             # will handle actual obj deletion"); the CR is released once no

@@ -74,6 +74,7 @@ def main() -> int:
     controller.start(workers=1)
     state_key = util.get_upgrade_state_label_key()
     try:
+        states = {}
         deadline = time.monotonic() + args.timeout
         while time.monotonic() < deadline:
             nodes = client.list("Node")

@@ -500,3 +500,31 @@ class TestInformerCacheKindsFilter:
         cache = InformerCache(cluster, lag_seconds=0.0)
         assert cache.full_syncs == 0  # pass-through mode: no full dump
         assert cache.get("Node", "n1")["metadata"]["name"] == "n1"
+
+
+class TestIndexToggleEquivalence:
+    """bench.py's indexes A/B toggle must not change list() semantics."""
+
+    def test_unindexed_lists_match_indexed(self):
+        indexed = InMemoryCluster()
+        scanning = InMemoryCluster(use_indexes=False)
+        for cluster in (indexed, scanning):
+            cluster.create(make_node("n1"))
+            cluster.create(make_pod("p1", "ml", "n1", labels={"app": "a"}))
+            cluster.create(make_pod("p2", "ml", "n2", labels={"app": "b"}))
+            cluster.create(make_pod("p3", "other", "n1", labels={"app": "a"}))
+
+        def names(cluster, **kw):
+            return [p["metadata"]["name"] for p in cluster.list("Pod", **kw)]
+
+        for kw in (
+            {},
+            {"namespace": "ml"},
+            {"label_selector": "app=a"},
+            {"field_selector": "spec.nodeName=n1"},
+            {"namespace": "ml", "field_selector": "spec.nodeName=n1"},
+        ):
+            assert names(indexed, **kw) == names(scanning, **kw), kw
+        assert [n["metadata"]["name"] for n in indexed.list("Node")] == [
+            n["metadata"]["name"] for n in scanning.list("Node")
+        ]
