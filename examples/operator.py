@@ -52,6 +52,11 @@ def run_real(args) -> int:
             KubeConfig.load(args.kubeconfig or None, context=args.context)
         )
     recorder = util.ClusterEventRecorder(client, namespace=args.namespace)
+    # Held watch streams for the controller's kinds (the informer
+    # pattern): events arrive pushed, not per-poll bounded watches.
+    client.start_held_watches(
+        ("Node", "Pod", "DaemonSet", "TpuUpgradePolicy")
+    )
     manager = ClusterUpgradeStateManager(client, recorder=recorder)
     labels = {}
     for pair in args.selector.split(","):
@@ -107,6 +112,7 @@ def run_real(args) -> int:
         pass
     finally:
         runnable.stop()
+        client.stop_held_watches()
     return 0
 
 

@@ -42,6 +42,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -200,19 +201,13 @@ class _Handler(BaseHTTPRequestHandler):
         }
         self._send_json(200, body)
 
-    def _serve_watch(self, info: KindInfo, query) -> None:
-        """Bounded watch: emit journal events after resourceVersion as
-        newline-delimited JSON frames, then close."""
-        try:
-            seq = int(query.get("resourceVersion") or 0)
-        except ValueError as err:
-            raise BadRequestError("resourceVersion must be an integer") from err
-        # Head BEFORE the scan (the Controller._watch_loop ordering): a
-        # write landing between the two reads is then past the bookmark
-        # and redelivered next poll — bookmarking a post-scan head would
-        # let the client skip it forever.
-        head = self.cluster.journal_seq()
-        events = self.cluster.events_since(seq, kind=info.kind)
+    #: Watches asking for more than this many seconds are HELD: the
+    #: response streams frames as journal events land, like a real
+    #: apiserver.  Shorter timeouts close after the initial batch — the
+    #: bounded-poll shim's synchronous contract.
+    HELD_WATCH_MIN_TIMEOUT = 2.0
+
+    def _encode_watch_frames(self, info: KindInfo, events) -> list:
         frames = []
         for ev in events:
             obj = ev.new if ev.new is not None else ev.old
@@ -230,34 +225,121 @@ class _Handler(BaseHTTPRequestHandler):
             frames.append(
                 json.dumps({"type": type_, "object": _with_gvk(obj, info)})
             )
-        if query.get("allowWatchBookmarks") in ("true", "1"):
+        return frames
+
+    def _bookmark_frame(self, info: KindInfo, position: int) -> str:
+        return json.dumps(
+            {
+                "type": "BOOKMARK",
+                "object": {
+                    "kind": info.kind,
+                    "metadata": {"resourceVersion": str(position)},
+                },
+            }
+        )
+
+    def _serve_watch(self, info: KindInfo, query) -> None:
+        """Watch: emit journal events after resourceVersion as
+        newline-delimited JSON frames.  Short timeouts close after the
+        initial batch (bounded poll); long ones HOLD the stream and push
+        frames as they land until the timeout expires."""
+        try:
+            seq = int(query.get("resourceVersion") or 0)
+        except ValueError as err:
+            raise BadRequestError("resourceVersion must be an integer") from err
+        try:
+            timeout_s = float(query.get("timeoutSeconds") or 0)
+        except ValueError:
+            timeout_s = 0.0
+        bookmarks = query.get("allowWatchBookmarks") in ("true", "1")
+        # Head BEFORE the scan (the Controller._watch_loop ordering): a
+        # write landing between the two reads is then past the bookmark
+        # and redelivered next poll — bookmarking a post-scan head would
+        # let the client skip it forever.
+        head = self.cluster.journal_seq()
+        events = self.cluster.events_since(seq, kind=info.kind)
+        frames = self._encode_watch_frames(info, events)
+        position = max([head] + [ev.seq for ev in events])
+        if timeout_s > self.HELD_WATCH_MIN_TIMEOUT:
+            self._serve_held_watch(info, frames, position, timeout_s, bookmarks)
+            return
+        if bookmarks:
             # Closing BOOKMARK (real apiservers send one when a timed-out
             # watch closes): the stream position at close, so quiet kinds
-            # stay fresh without borrowing another kind's RV.  Position =
-            # the pre-scan head or the last delivered frame, whichever is
-            # later — both are covered by this response.
-            position = max(
-                [head] + [ev.seq for ev in events]
-            )
-            frames.append(
-                json.dumps(
-                    {
-                        "type": "BOOKMARK",
-                        "object": {
-                            "kind": info.kind,
-                            "metadata": {
-                                "resourceVersion": str(position)
-                            },
-                        },
-                    }
-                )
-            )
+            # stay fresh without borrowing another kind's RV.
+            frames.append(self._bookmark_frame(info, position))
         data = ("\n".join(frames) + ("\n" if frames else "")).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _serve_held_watch(
+        self,
+        info: KindInfo,
+        initial_frames: list,
+        position: int,
+        timeout_s: float,
+        bookmarks: bool,
+    ) -> None:
+        """Stream frames as they land until *timeout_s* expires — the
+        held-stream contract real apiservers provide.  Termination is
+        connection-close delimited (no Content-Length), so the client
+        reads line by line as events arrive."""
+        self.close_connection = True
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        deadline = time.monotonic() + timeout_s
+        # The scan cursor is the GLOBAL journal position consumed so far —
+        # it advances on every wakeup regardless of whether the appended
+        # events matched our kind (waiting on `position`, which only moves
+        # on matching events, would busy-spin through foreign-kind churn).
+        cursor = position
+        try:
+            if initial_frames:
+                self.wfile.write(("\n".join(initial_frames) + "\n").encode())
+                self.wfile.flush()
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                # Event-driven on the store's condition variable: wakes on
+                # the next journal append or the chunk boundary.
+                head = self.cluster.wait_for_seq(
+                    cursor, timeout=min(remaining, 1.0)
+                )
+                if head <= cursor:
+                    continue  # timed out with no new journal entries
+                try:
+                    events = self.cluster.events_since(cursor, kind=info.kind)
+                except ExpiredError:
+                    # Journal rolled past us mid-hold: close WITHOUT a
+                    # closing bookmark — events of this kind may have been
+                    # lost in the rolled window, so the client must come
+                    # back with its stale position, get the 410, and
+                    # relist.  A head bookmark here would skip them for
+                    # good.
+                    return
+                cursor = max(cursor, head)
+                if events:
+                    frames = self._encode_watch_frames(info, events)
+                    position = max(position, max(ev.seq for ev in events))
+                    cursor = max(cursor, position)
+                    self.wfile.write(("\n".join(frames) + "\n").encode())
+                    self.wfile.flush()
+            if bookmarks:
+                self.wfile.write(
+                    (
+                        self._bookmark_frame(info, max(position, cursor))
+                        + "\n"
+                    ).encode()
+                )
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away mid-stream
 
     def _handle_post(self, info, namespace, name, subresource, query) -> None:
         body = self._read_body()
