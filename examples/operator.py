@@ -52,11 +52,6 @@ def run_real(args) -> int:
             KubeConfig.load(args.kubeconfig or None, context=args.context)
         )
     recorder = util.ClusterEventRecorder(client, namespace=args.namespace)
-    # Held watch streams for the controller's kinds (the informer
-    # pattern): events arrive pushed, not per-poll bounded watches.
-    client.start_held_watches(
-        ("Node", "Pod", "DaemonSet", "TpuUpgradePolicy")
-    )
     manager = ClusterUpgradeStateManager(client, recorder=recorder)
     labels = {}
     for pair in args.selector.split(","):
@@ -72,13 +67,19 @@ def run_real(args) -> int:
         labels[key] = value
 
     def make_controller():
-        return new_upgrade_controller(
+        # Held watch streams start/stop WITH the controller: a hot
+        # standby must not stream events nothing drains (the queue
+        # would grow to its cap and thrash the 410 recovery path).
+        controller = new_upgrade_controller(
             client,
             manager,
             args.namespace,
             labels,
             policy_source=CrPolicySource(client, args.policy, args.namespace),
             resync_seconds=args.resync_seconds,
+        )
+        return _HeldWatchRunnable(
+            client, ("Node", "Pod", "DaemonSet", "TpuUpgradePolicy"), controller
         )
 
     if args.ha:
@@ -112,8 +113,25 @@ def run_real(args) -> int:
         pass
     finally:
         runnable.stop()
-        client.stop_held_watches()
     return 0
+
+
+class _HeldWatchRunnable:
+    """Controller wrapper pairing held watch streams with its lifecycle
+    (streams run only while THIS replica's controller does)."""
+
+    def __init__(self, client, kinds, controller) -> None:
+        self._client = client
+        self._kinds = tuple(kinds)
+        self._controller = controller
+
+    def start(self, workers: int = 1) -> None:
+        self._client.start_held_watches(self._kinds)
+        self._controller.start(workers=workers)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._controller.stop(timeout)
+        self._client.stop_held_watches()
 
 
 class _DirectRunnable:

@@ -28,6 +28,7 @@ from .execauth import (
     ExecPluginSpec,
 )
 from .inmem import InMemoryCluster, WatchEvent, merge_patch
+from .strategicmerge import register_merge_key, strategic_merge
 from .kubeclient import KubeApiClient, KubeConfig, KubeConfigError
 from .retry import retry_on_conflict
 from .selectors import labels_to_selector, match_label_selector, matches, parse_selector
@@ -46,6 +47,8 @@ __all__ = [
     "InMemoryCluster",
     "WatchEvent",
     "merge_patch",
+    "register_merge_key",
+    "strategic_merge",
     "retry_on_conflict",
     "parse_selector",
     "match_label_selector",

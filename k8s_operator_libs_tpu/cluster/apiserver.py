@@ -390,9 +390,18 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._read_body()
         if body is None or not name:
             raise BadRequestError("PATCH requires an object path and a body")
-        # merge-patch and strategic-merge coincide for the map-typed
-        # fields this library patches (labels/annotations/spec scalars).
-        patched = self.cluster.patch(info.kind, name, body, namespace)
+        content_type = (self.headers.get("Content-Type") or "").split(";")[0]
+        if content_type == "application/strategic-merge-patch+json":
+            patch_type = "strategic"
+        elif content_type in ("application/merge-patch+json", "", "application/json"):
+            patch_type = "merge"
+        else:
+            raise BadRequestError(
+                f"unsupported patch content type {content_type!r}"
+            )
+        patched = self.cluster.patch(
+            info.kind, name, body, namespace, patch_type=patch_type
+        )
         self._send_json(200, _with_gvk(patched, info))
 
     def _handle_delete(self, info, namespace, name, subresource, query) -> None:

@@ -59,7 +59,12 @@ class ClusterClient(Protocol):
     def update_status(self, obj: JsonObj) -> JsonObj: ...
 
     def patch(
-        self, kind: str, name: str, patch_body: JsonObj, namespace: str = ""
+        self,
+        kind: str,
+        name: str,
+        patch_body: JsonObj,
+        namespace: str = "",
+        patch_type: str = "merge",
     ) -> JsonObj: ...
 
     def delete(

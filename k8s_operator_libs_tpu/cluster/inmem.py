@@ -329,16 +329,25 @@ class InMemoryCluster:
     update_status = update
 
     def patch(
-        self, kind: str, name: str, patch_body: JsonObj, namespace: str = ""
+        self,
+        kind: str,
+        name: str,
+        patch_body: JsonObj,
+        namespace: str = "",
+        patch_type: str = "merge",
     ) -> JsonObj:
-        """JSON merge patch (RFC 7386).  Strategic-merge is the same for the
-        map-typed fields (labels/annotations) this library patches.
+        """JSON merge patch (RFC 7386, the default) or strategic merge
+        (``patch_type="strategic"`` — list-aware Kubernetes semantics,
+        see :mod:`.strategicmerge`).  The two coincide for the map-typed
+        fields (labels/annotations) this library patches internally.
 
         If the patch carries ``metadata.resourceVersion`` the server enforces
         it (optimistic lock) — this is how the reference's shared-requestor
         patch protocol detects concurrent writers
         (upgrade_requestor.go:344-357).
         """
+        if patch_type not in ("merge", "strategic"):
+            raise BadRequestError(f"unsupported patch type {patch_type!r}")
         with self._lock:
             key = (kind, namespace, name)
             current = self._store.get(key)
@@ -351,7 +360,12 @@ class InMemoryCluster:
                     f"{current['metadata']['resourceVersion']}"
                 )
             old = json_copy(current)
-            merged = merge_patch(current, patch_body)
+            if patch_type == "strategic":
+                from .strategicmerge import strategic_merge
+
+                merged = strategic_merge(current, patch_body, kind=kind)
+            else:
+                merged = merge_patch(current, patch_body)
             # kind / name / namespace / uid are immutable, like a real apiserver
             merged["kind"] = kind
             merged["metadata"]["uid"] = current["metadata"]["uid"]

@@ -17,10 +17,12 @@ Capabilities mapped to the reference:
 * **CRUD + patch routing** — create/get/list/update/patch/delete over
   the standard REST layout resolved from the shared
   :data:`~.client.KIND_REGISTRY`; PATCH sends
-  ``application/merge-patch+json`` (the library's label/annotation
-  patches are merge-patches; the reference's one strategic-merge use —
-  the state label patch, node_upgrade_state_provider.go:80-82 — is
-  byte-identical as a merge patch for map-typed fields).
+  ``application/merge-patch+json`` by default or
+  ``application/strategic-merge-patch+json`` with
+  ``patch_type="strategic"`` (list-aware Kubernetes semantics, see
+  :mod:`.strategicmerge` — the reference's one strategic use, the state
+  label patch at node_upgrade_state_provider.go:80-82, is byte-identical
+  either way for map-typed fields).
 * **Eviction subresource** — ``evict()`` POSTs ``policy/v1`` Eviction
   and maps 429 onto :class:`~.errors.TooManyRequestsError` so kubectl-
   drain retry semantics work unchanged (drain_manager.go:109-133).
@@ -638,14 +640,28 @@ class KubeApiClient:
         return updated
 
     def patch(
-        self, kind: str, name: str, patch_body: JsonObj, namespace: str = ""
+        self,
+        kind: str,
+        name: str,
+        patch_body: JsonObj,
+        namespace: str = "",
+        patch_type: str = "merge",
     ) -> JsonObj:
+        """PATCH with ``merge`` (RFC 7386, default) or ``strategic``
+        (Kubernetes list-aware) semantics — the content type selects the
+        server-side behavior, exactly as client-go's Patch types do."""
+        if patch_type == "strategic":
+            content_type = "application/strategic-merge-patch+json"
+        elif patch_type == "merge":
+            content_type = "application/merge-patch+json"
+        else:
+            raise BadRequestError(f"unsupported patch type {patch_type!r}")
         info = kind_info(kind)
         _, patched = self._request(
             "PATCH",
             info.path(namespace=namespace, name=quote(name)),
             body=patch_body,
-            content_type="application/merge-patch+json",
+            content_type=content_type,
         )
         return patched
 
@@ -968,17 +984,43 @@ class KubeApiClient:
         Single-consumer: one events_since caller (the Controller) drains
         the queue.  A kind's 410 resets its informer state and surfaces
         one ExpiredError from the next events_since so the caller
-        relists, while the stream reconnects from a fresh seed."""
+        relists, while the stream reconnects from a fresh seed.  The
+        Controller detects held coverage via :attr:`held_watch_kinds`
+        and switches to blocking on :meth:`wait_for_held_event` — no
+        journal_seq LIST per poll."""
         if self._held_watchers:
             raise RuntimeError("held watches already started")
         wanted = frozenset(kinds)
         for k in sorted(wanted):
             kind_info(k)  # fail fast on unregistered kinds, state untouched
         self._held_kinds = wanted
+        # Events stashed by a pre-held bounded-poll 410 (their bookmarks
+        # already advanced past them) must flow into the held queue, or
+        # they are stranded for good — the held branch never reads the
+        # pending stash.
+        with self._last_seen_lock:
+            flush = [
+                e
+                for e in self._pending_events
+                if (e.new or e.old or {}).get("kind") in wanted
+            ]
+            self._pending_events = [
+                e
+                for e in self._pending_events
+                if (e.new or e.old or {}).get("kind") not in wanted
+            ]
+        for e in flush:
+            self._held_enqueue(e)
         for k in sorted(wanted):
             watcher = _HeldWatcher(self, k, hold_seconds)
             self._held_watchers.append(watcher)
             watcher.start()
+
+    @property
+    def held_watch_kinds(self) -> frozenset:
+        """Kinds currently covered by held watch streams (empty set
+        when polling) — consumers use it to pick their wait strategy."""
+        return self._held_kinds
 
     def stop_held_watches(self) -> None:
         for watcher in self._held_watchers:

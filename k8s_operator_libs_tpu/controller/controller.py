@@ -203,14 +203,27 @@ class Controller:
         watched_kinds = tuple(sorted({w.kind for w in self._watches}))
         while not self._stop.is_set():
             try:
-                # Take the journal head BEFORE scanning: kind-filtered
-                # polls that return nothing must still advance the
-                # bookmark, else unwatched-kind churn (Lease renewals,
-                # pod writes) slides the retention window past a frozen
-                # _last_seq and every poll becomes a spurious 410 relist.
-                # Head-first ordering keeps this loss-free — events
-                # recorded after the head read are found by the next scan.
-                head = self._cluster.journal_seq()
+                # Held-stream coverage (KubeApiClient.start_held_watches):
+                # events arrive pushed and pop-once — no journal head to
+                # take (the drain ignores the cursor) and no HTTP per
+                # poll; block on the stream's condition instead.
+                held = getattr(self._cluster, "held_watch_kinds", None)
+                use_held = bool(held) and set(watched_kinds) <= held
+                if use_held:
+                    head = self._last_seq
+                    self._cluster.wait_for_held_event(
+                        timeout=max(self._poll, 0.05)
+                    )
+                else:
+                    # Take the journal head BEFORE scanning: kind-filtered
+                    # polls that return nothing must still advance the
+                    # bookmark, else unwatched-kind churn (Lease renewals,
+                    # pod writes) slides the retention window past a frozen
+                    # _last_seq and every poll becomes a spurious 410
+                    # relist.  Head-first ordering keeps this loss-free —
+                    # events recorded after the head read are found by the
+                    # next scan.
+                    head = self._cluster.journal_seq()
                 # Pass the watched-kind set so HTTP backends issue one
                 # bounded watch per WATCHED kind, not per registered kind.
                 events = self._cluster.events_since(

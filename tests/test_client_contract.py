@@ -1377,3 +1377,211 @@ class TestHeldWatchStreams:
             client.stop_held_watches()
             assert time.monotonic() - t0 < 5.0
             assert client._held_kinds == frozenset()
+
+
+class TestStrategicMergePatch:
+    """VERDICT r2 missing #4: strategic merge patch — list-of-maps fields
+    merge by their Kubernetes patchMergeKey instead of being replaced
+    wholesale (RFC 7386), on BOTH backends via the content type."""
+
+    def _pod(self, client):
+        pod = make_pod("p1", "ml", "n1")
+        pod["spec"]["containers"] = [
+            {"name": "main", "image": "app:v1", "env": [{"name": "A", "value": "1"}]},
+            {"name": "sidecar", "image": "side:v1"},
+        ]
+        client.create(pod)
+
+    def test_keyed_list_merges_by_name(self, backend):
+        client, _ = backend
+        self._pod(client)
+        patched = client.patch(
+            "Pod",
+            "p1",
+            {"spec": {"containers": [{"name": "main", "image": "app:v2"}]}},
+            "ml",
+            patch_type="strategic",
+        )
+        containers = {c["name"]: c for c in patched["spec"]["containers"]}
+        assert containers["main"]["image"] == "app:v2"
+        assert containers["main"]["env"] == [{"name": "A", "value": "1"}]
+        assert containers["sidecar"]["image"] == "side:v1"  # untouched
+
+    def test_merge_patch_replaces_the_whole_list(self, backend):
+        """The RFC 7386 behavior the strategic type exists to avoid."""
+        client, _ = backend
+        self._pod(client)
+        patched = client.patch(
+            "Pod",
+            "p1",
+            {"spec": {"containers": [{"name": "main", "image": "app:v2"}]}},
+            "ml",
+            patch_type="merge",
+        )
+        assert [c["name"] for c in patched["spec"]["containers"]] == ["main"]
+
+    def test_patch_delete_directive_removes_element(self, backend):
+        client, _ = backend
+        self._pod(client)
+        patched = client.patch(
+            "Pod",
+            "p1",
+            {
+                "spec": {
+                    "containers": [{"name": "sidecar", "$patch": "delete"}]
+                }
+            },
+            "ml",
+            patch_type="strategic",
+        )
+        assert [c["name"] for c in patched["spec"]["containers"]] == ["main"]
+
+    def test_node_taints_merge_by_key(self, backend):
+        client, _ = backend
+        node = make_node("n1")
+        node["spec"]["taints"] = [
+            {"key": "tpu", "effect": "NoSchedule", "value": "v5"}
+        ]
+        client.create(node)
+        patched = client.patch(
+            "Node",
+            "n1",
+            {
+                "spec": {
+                    "taints": [
+                        {"key": "maintenance", "effect": "NoExecute"}
+                    ]
+                }
+            },
+            patch_type="strategic",
+        )
+        keys = sorted(t["key"] for t in patched["spec"]["taints"])
+        assert keys == ["maintenance", "tpu"]  # appended, not replaced
+
+    def test_unkeyed_list_stays_atomic(self, backend):
+        client, _ = backend
+        node = make_node("n1")
+        node["spec"]["podCIDRs"] = ["10.0.0.0/24", "10.0.1.0/24"]
+        client.create(node)
+        patched = client.patch(
+            "Node",
+            "n1",
+            {"spec": {"podCIDRs": ["10.9.0.0/24"]}},
+            patch_type="strategic",
+        )
+        assert patched["spec"]["podCIDRs"] == ["10.9.0.0/24"]
+
+    def test_replace_directive_on_keyed_list(self, backend):
+        client, _ = backend
+        self._pod(client)
+        patched = client.patch(
+            "Pod",
+            "p1",
+            {
+                "spec": {
+                    "containers": [
+                        {"$patch": "replace"},
+                        {"name": "only", "image": "x:1"},
+                    ]
+                }
+            },
+            "ml",
+            patch_type="strategic",
+        )
+        assert [c["name"] for c in patched["spec"]["containers"]] == ["only"]
+
+    def test_unsupported_directive_rejected(self, backend):
+        from k8s_operator_libs_tpu.cluster import BadRequestError
+
+        client, _ = backend
+        self._pod(client)
+        with pytest.raises(BadRequestError):
+            client.patch(
+                "Pod",
+                "p1",
+                {"spec": {"$setElementOrder/containers": []}},
+                "ml",
+                patch_type="strategic",
+            )
+
+    def test_rv_guard_applies_to_strategic_patches(self, backend):
+        client, _ = backend
+        self._pod(client)
+        stale = client.get("Pod", "p1", "ml")
+        client.patch(
+            "Pod", "p1", {"metadata": {"labels": {"x": "1"}}}, "ml"
+        )
+        with pytest.raises(ConflictError):
+            client.patch(
+                "Pod",
+                "p1",
+                {
+                    "metadata": {
+                        "resourceVersion": stale["metadata"]["resourceVersion"]
+                    },
+                    "spec": {"containers": [{"name": "main", "image": "z"}]},
+                },
+                "ml",
+                patch_type="strategic",
+            )
+
+    def test_patch_merge_directive_stripped(self, backend):
+        """Review regression: '$patch': 'merge' (the explicit default) is
+        applied, never stored as a literal key."""
+        client, _ = backend
+        self._pod(client)
+        patched = client.patch(
+            "Pod",
+            "p1",
+            {
+                "spec": {
+                    "containers": [
+                        {"name": "main", "$patch": "merge", "image": "a:2"}
+                    ]
+                }
+            },
+            "ml",
+            patch_type="strategic",
+        )
+        main = [
+            c for c in patched["spec"]["containers"] if c["name"] == "main"
+        ][0]
+        assert main["image"] == "a:2"
+        assert "$patch" not in main
+
+    def test_patch_delete_map_key(self, backend):
+        client, _ = backend
+        node = make_node("n1")
+        node["spec"]["providerID"] = "x"
+        node["metadata"]["labels"]["keep"] = "1"
+        client.create(node)
+        patched = client.patch(
+            "Node",
+            "n1",
+            {"metadata": {"labels": {"$patch": "delete"}}},
+            patch_type="strategic",
+        )
+        assert "labels" not in patched["metadata"]
+        assert patched["spec"]["providerID"] == "x"
+
+    def test_unknown_patch_directive_rejected(self, backend):
+        from k8s_operator_libs_tpu.cluster import BadRequestError
+
+        client, _ = backend
+        self._pod(client)
+        with pytest.raises(BadRequestError):
+            client.patch(
+                "Pod",
+                "p1",
+                {"spec": {"containers": [{"name": "main", "$patch": "explode"}]}},
+                "ml",
+                patch_type="strategic",
+            )
+        with pytest.raises(BadRequestError):
+            client.patch(
+                "Pod",
+                "p1",
+                {"spec": {"nodeSelector": {"$patch": "explode"}}},
+                "ml",
+                patch_type="strategic",
+            )
