@@ -95,7 +95,6 @@ def run_real(args) -> int:
         )
     else:
         runnable = make_controller()
-        runnable = _DirectRunnable(runnable)
     runnable.start()
     print(
         f"operator running against {client.config.server} "
@@ -132,19 +131,6 @@ class _HeldWatchRunnable:
     def stop(self, timeout: float = 10.0) -> None:
         self._controller.stop(timeout)
         self._client.stop_held_watches()
-
-
-class _DirectRunnable:
-    """Uniform start/stop shim for the non-HA single-replica path."""
-
-    def __init__(self, controller) -> None:
-        self._controller = controller
-
-    def start(self) -> None:
-        self._controller.start(workers=1)
-
-    def stop(self) -> None:
-        self._controller.stop()
 
 
 def main() -> int:

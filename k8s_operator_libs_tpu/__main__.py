@@ -39,23 +39,55 @@ def _parse_selector_arg(selector: str) -> dict:
 
 
 def cmd_status(args: argparse.Namespace) -> int:
-    try:
-        with open(args.state_file, "r", encoding="utf-8") as fh:
-            cluster = InMemoryCluster.from_dict(json.load(fh))
-    except FileNotFoundError:
-        print(f"state file not found: {args.state_file}", file=sys.stderr)
-        return 2
-    except (json.JSONDecodeError, KeyError, TypeError, ValueError) as err:
+    if args.kubeconfig is not None or args.in_cluster:
+        # Live mode: compute the status from a real cluster through
+        # KubeApiClient (same client surface as the operator).
+        from .cluster import KubeApiClient, KubeConfig, KubeConfigError
+
+        try:
+            if args.in_cluster:
+                cluster = KubeApiClient(KubeConfig.in_cluster())
+            else:
+                cluster = KubeApiClient(
+                    KubeConfig.load(args.kubeconfig or None, context=args.context)
+                )
+        except KubeConfigError as err:
+            print(f"cannot load cluster config: {err}", file=sys.stderr)
+            return 2
+    elif args.state_file:
+        try:
+            with open(args.state_file, "r", encoding="utf-8") as fh:
+                cluster = InMemoryCluster.from_dict(json.load(fh))
+        except FileNotFoundError:
+            print(f"state file not found: {args.state_file}", file=sys.stderr)
+            return 2
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as err:
+            print(
+                f"state file {args.state_file} is not a cluster dump: {err}",
+                file=sys.stderr,
+            )
+            return 2
+    else:
         print(
-            f"state file {args.state_file} is not a cluster dump: {err}",
+            "status needs a source: --state-file DUMP, --kubeconfig "
+            "[PATH], or --in-cluster",
             file=sys.stderr,
         )
         return 2
     util.set_component_name(args.component)
+    from .cluster.errors import ApiError
+
     manager = ClusterUpgradeStateManager(cluster)
-    state = manager.build_state(
-        args.namespace, _parse_selector_arg(args.selector)
-    )
+    try:
+        state = manager.build_state(
+            args.namespace, _parse_selector_arg(args.selector)
+        )
+    except (ApiError, OSError) as err:
+        # Live mode: unreachable apiserver / auth failure / 5xx must keep
+        # the documented exit-code contract (2 = cannot read the source),
+        # not escape as a traceback.
+        print(f"cannot read cluster state: {err}", file=sys.stderr)
+        return 2
     policy = None
     if args.policy:
         from .api import UpgradePolicySpec, ValidationError
@@ -67,6 +99,12 @@ def cmd_status(args: argparse.Namespace) -> int:
             print(
                 f"TpuUpgradePolicy {args.namespace}/{args.policy} not found "
                 f"in the dump; gates not evaluated",
+                file=sys.stderr,
+            )
+        except (ApiError, OSError) as err:
+            print(
+                f"cannot read TpuUpgradePolicy {args.namespace}/"
+                f"{args.policy}: {err}; gates not evaluated",
                 file=sys.stderr,
             )
         else:
@@ -107,7 +145,19 @@ def main(argv=None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
 
     st = sub.add_parser("status", help="print rollout status")
-    st.add_argument("--state-file", required=True, help="cluster dump JSON")
+    st.add_argument(
+        "--state-file", default="", help="cluster dump JSON (offline mode)"
+    )
+    st.add_argument(
+        "--kubeconfig",
+        nargs="?",
+        const="",
+        default=None,
+        help="live mode against a real cluster (no value = $KUBECONFIG "
+        "then ~/.kube/config)",
+    )
+    st.add_argument("--context", default=None)
+    st.add_argument("--in-cluster", action="store_true")
     st.add_argument("--namespace", default="tpu-ops")
     st.add_argument(
         "--selector",

@@ -768,9 +768,19 @@ class KubeApiClient:
             if held_part:
                 # Mixed request: drain the streamed kinds (never bounded-
                 # poll them — the stream's bookmarks are already past the
-                # queued frames) and poll only the rest.
+                # queued frames) and poll only the rest.  If the poll
+                # side 410s, the already-popped held events go BACK to
+                # the queue front (pop-once delivery must not turn into
+                # zero-times on an unrelated kind's expiry).
                 merged = self._drain_held(held_part)
-                merged.extend(self.events_since(seq, kind=tuple(poll_part)))
+                try:
+                    merged.extend(
+                        self.events_since(seq, kind=tuple(poll_part))
+                    )
+                except BaseException:
+                    with self._held_cond:
+                        self._held_queue.extendleft(reversed(merged))
+                    raise
                 merged.sort(key=lambda e: e.seq)
                 return merged
         # Start from frames consumed by a previous poll that died on a

@@ -91,12 +91,16 @@ def strategic_merge(
             return {k: v for k, v in patch.items() if k != "$patch"}
         if directive == "merge":  # explicit default strategy
             patch = {k: v for k, v in patch.items() if k != "$patch"}
-        elif directive is not None and directive != "delete":
-            # 'delete' is handled by the PARENT (map-valued: drop the
-            # key; keyed-list element: remove the element); anything
-            # else must fail loudly, never be stored as a literal key.
+        elif directive is not None:
+            # 'delete' is consumed by the PARENT before recursing
+            # (map-valued: drop the key; keyed-list element: remove the
+            # element) — one reaching here is at the patch root, where
+            # it has no parent and no meaning.  Everything else is
+            # unknown.  Either way: fail loudly, never store a literal
+            # '$patch' key.
             raise BadRequestError(
-                f"unknown $patch directive {directive!r}"
+                f"$patch directive {directive!r} is not valid here"
+                + (" (patch root)" if not path else "")
             )
         if not isinstance(target, dict):
             target = {}

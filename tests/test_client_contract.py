@@ -1585,3 +1585,53 @@ class TestStrategicMergePatch:
                 "ml",
                 patch_type="strategic",
             )
+
+    def test_root_patch_delete_rejected(self, backend):
+        from k8s_operator_libs_tpu.cluster import BadRequestError
+
+        client, _ = backend
+        client.create(make_node("n1"))
+        with pytest.raises(BadRequestError):
+            client.patch(
+                "Node", "n1", {"$patch": "delete"}, patch_type="strategic"
+            )
+        # the directive never reached the store as a literal key
+        assert "$patch" not in client.get("Node", "n1")
+
+
+class TestHeldMixedRequests:
+    """Mixed held+polled events_since requests."""
+
+    def test_poll_410_requeues_popped_held_events(self):
+        """Review regression: when the polled side of a mixed request
+        410s, the already-popped held events must return to the queue —
+        pop-once must not become zero-times."""
+        store = InMemoryCluster()
+        with ApiServerFacade(store) as facade:
+            client = KubeApiClient(KubeConfig(server=facade.url), timeout=10.0)
+            client.start_held_watches(("Node",), hold_seconds=3.0)
+            try:
+                seq = client.journal_seq()
+                client.create(make_node("n1"))
+                # wait until the stream has pushed the Added into the queue
+                assert client.wait_for_held_event(timeout=5.0)
+                # make the DaemonSet bounded poll expire: stale bookmark
+                # under a tiny journal window
+                store._journal_cap = 4
+                for i in range(8):
+                    client.create(make_pod(f"p{i}", "ml", "nX"))
+                with client._last_seen_lock:
+                    client._kind_bookmarks["DaemonSet"] = 1
+                    client._seeded_kinds.add("DaemonSet")
+                with pytest.raises(ExpiredError):
+                    client.events_since(seq, kind=("Node", "DaemonSet"))
+                # the popped Node event is back and still delivered
+                events = client.events_since(seq, kind=("Node",))
+                names = [
+                    (e.new or {}).get("metadata", {}).get("name")
+                    for e in events
+                    if e.type == "Added"
+                ]
+                assert "n1" in names
+            finally:
+                client.stop_held_watches()

@@ -508,3 +508,68 @@ class TestCliPolicyTopologyAndValidation:
         )
         assert rc == 2
         assert "invalid" in capsys.readouterr().err
+
+
+class TestStatusCliLiveMode:
+    """`status --kubeconfig`: the CLI computes live from a real apiserver
+    through KubeApiClient — no dump file."""
+
+    def test_live_status_over_http(self, cluster, tmp_path, capsys):
+        from k8s_operator_libs_tpu.cluster import ApiServerFacade
+
+        _mixed_fleet(cluster)
+        with ApiServerFacade(cluster) as facade:
+            kubeconfig = tmp_path / "kubeconfig"
+            kubeconfig.write_text(
+                "\n".join(
+                    [
+                        "apiVersion: v1",
+                        "kind: Config",
+                        "current-context: test",
+                        "contexts:",
+                        "- name: test",
+                        "  context: {cluster: test, user: test}",
+                        "clusters:",
+                        "- name: test",
+                        f"  cluster: {{server: {facade.url}}}",
+                        "users:",
+                        "- name: test",
+                        "  user: {token: dummy}",
+                    ]
+                )
+            )
+            rc = cli_main(
+                ["status", "--kubeconfig", str(kubeconfig), "--json"]
+            )
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert data["done"] == 1 and data["totalNodes"] == 4
+
+    def test_no_source_is_an_error(self, capsys):
+        rc = cli_main(["status"])
+        assert rc == 2
+        assert "needs a source" in capsys.readouterr().err
+
+    def test_live_mode_unreachable_server_exits_2(self, tmp_path, capsys):
+        kubeconfig = tmp_path / "kubeconfig"
+        kubeconfig.write_text(
+            "\n".join(
+                [
+                    "apiVersion: v1",
+                    "kind: Config",
+                    "current-context: test",
+                    "contexts:",
+                    "- name: test",
+                    "  context: {cluster: test, user: test}",
+                    "clusters:",
+                    "- name: test",
+                    "  cluster: {server: 'http://127.0.0.1:1'}",
+                    "users:",
+                    "- name: test",
+                    "  user: {token: dummy}",
+                ]
+            )
+        )
+        rc = cli_main(["status", "--kubeconfig", str(kubeconfig)])
+        assert rc == 2
+        assert "cannot read cluster state" in capsys.readouterr().err
