@@ -39,6 +39,13 @@ def _parse_selector_arg(selector: str) -> dict:
 
 
 def cmd_status(args: argparse.Namespace) -> int:
+    if (args.kubeconfig is not None or args.in_cluster) and args.state_file:
+        print(
+            "status takes ONE source: --state-file or "
+            "--kubeconfig/--in-cluster, not both",
+            file=sys.stderr,
+        )
+        return 2
     if args.kubeconfig is not None or args.in_cluster:
         # Live mode: compute the status from a real cluster through
         # KubeApiClient (same client surface as the operator).

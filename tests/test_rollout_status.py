@@ -573,3 +573,18 @@ class TestStatusCliLiveMode:
         rc = cli_main(["status", "--kubeconfig", str(kubeconfig)])
         assert rc == 2
         assert "cannot read cluster state" in capsys.readouterr().err
+
+    def test_conflicting_sources_rejected(self, tmp_path, capsys):
+        dump = tmp_path / "dump.json"
+        dump.write_text("{}")
+        rc = cli_main(
+            [
+                "status",
+                "--state-file",
+                str(dump),
+                "--kubeconfig",
+                str(tmp_path / "kc"),
+            ]
+        )
+        assert rc == 2
+        assert "ONE source" in capsys.readouterr().err
