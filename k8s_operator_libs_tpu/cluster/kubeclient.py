@@ -64,6 +64,7 @@ from http.client import HTTPConnection, HTTPResponse, HTTPSConnection
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import quote, urlencode, urlparse
 
+from .. import metrics
 from .client import KIND_REGISTRY, JsonObj, KindInfo, kind_info
 from .execauth import (
     ExecCredential,
@@ -906,6 +907,7 @@ class KubeApiClient:
     def _reset_kind_state(self, k: str) -> None:
         """Drop a kind's informer-local state after a 410 so the next
         touch re-seeds from a fresh list."""
+        metrics.record_watch_expired(k)
         with self._last_seen_lock:
             self._kind_bookmarks.pop(k, None)
             self._seeded_kinds.discard(k)
@@ -1042,6 +1044,7 @@ class KubeApiClient:
         with self._held_cond:
             self._held_queue.clear()
             self._held_expired.clear()
+        metrics.set_held_queue_depth(0)
 
     def _drain_held(self, kinds) -> List[WatchEvent]:
         """Pop queued events of *kinds*, exactly once each.  The queue IS
@@ -1066,6 +1069,8 @@ class KubeApiClient:
                 else:
                     keep.append(e)
             self._held_queue = keep
+            depth = len(keep)
+        metrics.set_held_queue_depth(depth)
         events.sort(key=lambda e: e.seq)
         return events
 
@@ -1078,9 +1083,12 @@ class KubeApiClient:
                 self._held_expired.update(self._held_kinds)
                 for k in self._held_kinds:
                     self._reset_kind_state(k)
+                metrics.set_held_queue_depth(0)
                 return
             self._held_queue.append(event)
             self._held_cond.notify_all()
+            depth = len(self._held_queue)
+        metrics.set_held_queue_depth(depth)
 
     def _held_mark_expired(self, k: str) -> None:
         with self._held_cond:
@@ -1171,8 +1179,12 @@ class _HeldWatcher(threading.Thread):
 
     # ------------------------------------------------------------- running
     def run(self) -> None:
+        first = True
         while not self._stop_event.is_set():
             try:
+                if not first:
+                    metrics.record_watch_reconnect(self._kind)
+                first = False
                 self._run_stream()
             except ExpiredError:
                 self._client._reset_kind_state(self._kind)

@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from .. import metrics
 from ..cluster.errors import AlreadyExistsError, ConflictError, NotFoundError
 from ..cluster.client import ClusterClient
 from ..cluster.inmem import JsonObj
@@ -110,7 +111,7 @@ class LeaderElector:
         if self._thread is not None:
             self._thread.join(timeout)
         if self.is_leader:
-            self._demote()
+            self._demote(event="released")
         # Release unconditionally (it no-ops unless we hold the lease on
         # the server): a deadline-demoted leader has is_leader False but
         # may still be the nominal holder after a healed partition — the
@@ -171,6 +172,7 @@ class LeaderElector:
     def _promote(self) -> None:
         with self._lock:
             self._is_leader = True
+        metrics.record_leader_transition("acquired")
         logger.info("%s: became leader of %s", self.identity, self._lock_name)
         if self._on_started is not None:
             try:
@@ -196,10 +198,16 @@ class LeaderElector:
                         err,
                     )
 
-    def _demote(self) -> None:
+    def _demote(self, event: str = "lost") -> None:
+        """*event* labels the transition metric: "lost" for involuntary
+        demotions (renew deadline, failed promotion), "released" for a
+        voluntary stop() — alerts on involuntary loss must not fire on
+        routine rolling restarts."""
         with self._lock:
             was = self._is_leader
             self._is_leader = False
+        if was:
+            metrics.record_leader_transition(event)
         if was and self._on_stopped is not None:
             try:
                 self._on_stopped()

@@ -385,3 +385,37 @@ def publish_rollout_gauges(
     )
     reg.gauge("upgrades_failed", "Nodes in upgrade-failed.").set(failed)
     reg.gauge("upgrades_done", "Nodes at the target revision.").set(done)
+
+
+def record_watch_reconnect(kind: str) -> None:
+    """A held watch stream reconnected (hold expiry or transport error)."""
+    default_registry().counter(
+        "watch_stream_reconnects_total",
+        "Held watch stream reconnects, by kind.",
+        ("kind",),
+    ).inc(kind)
+
+
+def record_watch_expired(kind: str) -> None:
+    """A watch position fell out of the server's retention window (410)."""
+    default_registry().counter(
+        "watch_expirations_total",
+        "Watch 410 Gone resets (full relist triggered), by kind.",
+        ("kind",),
+    ).inc(kind)
+
+
+def set_held_queue_depth(depth: int) -> None:
+    default_registry().gauge(
+        "held_watch_queue_depth",
+        "Events buffered in the held-watch queue awaiting drain.",
+    ).set(depth)
+
+
+def record_leader_transition(event: str) -> None:
+    """Leader-election lifecycle: acquired | lost | released."""
+    default_registry().counter(
+        "leader_transitions_total",
+        "Leader-election transitions of this replica, by event.",
+        ("event",),
+    ).inc(event)

@@ -310,3 +310,53 @@ class TestStateMachineWiring:
             fresh_registry.counter("drains_total", "", ("result",)).value("failed")
             >= 1
         )
+
+
+class TestWatchAndLeaderMetrics:
+    """Round-3 observability: watch-stream and leader-election metrics."""
+
+    def test_watch_expired_counter(self, fresh_registry):
+        from k8s_operator_libs_tpu import metrics
+
+        metrics.record_watch_expired("Node")
+        metrics.record_watch_expired("Node")
+        out = fresh_registry.render()
+        assert (
+            'watch_expirations_total{kind="Node"} 2' in out
+        )
+
+    def test_reconnect_counter_and_queue_gauge(self, fresh_registry):
+        from k8s_operator_libs_tpu import metrics
+
+        metrics.record_watch_reconnect("Pod")
+        metrics.set_held_queue_depth(7)
+        out = fresh_registry.render()
+        assert 'watch_stream_reconnects_total{kind="Pod"} 1' in out
+        assert "held_watch_queue_depth 7" in out
+
+    def test_leader_transitions_from_elector(self, fresh_registry):
+        import time
+
+        from k8s_operator_libs_tpu.cluster import InMemoryCluster
+        from k8s_operator_libs_tpu.controller import LeaderElector
+
+        cluster = InMemoryCluster()
+        elector = LeaderElector(
+            cluster,
+            "bench-lock",
+            "me",
+            lease_duration=0.6,
+            renew_deadline=0.4,
+            retry_period=0.05,
+        )
+        elector.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not elector.is_leader:
+            time.sleep(0.02)
+        assert elector.is_leader
+        elector.stop()
+        out = fresh_registry.render()
+        # >=1, not ==1: a loaded CI box can deadline-demote and
+        # re-acquire mid-test; a voluntary stop records "released"
+        assert 'leader_transitions_total{event="acquired"}' in out
+        assert 'leader_transitions_total{event="released"} 1' in out
