@@ -845,6 +845,7 @@ class KubeApiClient:
                 # Frames already consumed from EARLIER kinds this call are
                 # stashed for the next poll: their bookmarks advanced past
                 # them, so raising without stashing would lose them.
+                metrics.record_watch_expired(k)
                 self._reset_kind_state(k)
                 with self._last_seen_lock:
                     self._pending_events.extend(events)
@@ -907,7 +908,6 @@ class KubeApiClient:
     def _reset_kind_state(self, k: str) -> None:
         """Drop a kind's informer-local state after a 410 so the next
         touch re-seeds from a fresh list."""
-        metrics.record_watch_expired(k)
         with self._last_seen_lock:
             self._kind_bookmarks.pop(k, None)
             self._seeded_kinds.discard(k)
@@ -1069,8 +1069,7 @@ class KubeApiClient:
                 else:
                     keep.append(e)
             self._held_queue = keep
-            depth = len(keep)
-        metrics.set_held_queue_depth(depth)
+            metrics.set_held_queue_depth(len(keep))
         events.sort(key=lambda e: e.seq)
         return events
 
@@ -1083,12 +1082,14 @@ class KubeApiClient:
                 self._held_expired.update(self._held_kinds)
                 for k in self._held_kinds:
                     self._reset_kind_state(k)
+                metrics.record_held_queue_overflow()
                 metrics.set_held_queue_depth(0)
                 return
             self._held_queue.append(event)
             self._held_cond.notify_all()
-            depth = len(self._held_queue)
-        metrics.set_held_queue_depth(depth)
+            # inside the lock: a deferred stale depth from a slow
+            # enqueuer must not overwrite a newer drain's zero
+            metrics.set_held_queue_depth(len(self._held_queue))
 
     def _held_mark_expired(self, k: str) -> None:
         with self._held_cond:
@@ -1187,6 +1188,7 @@ class _HeldWatcher(threading.Thread):
                 first = False
                 self._run_stream()
             except ExpiredError:
+                metrics.record_watch_expired(self._kind)
                 self._client._reset_kind_state(self._kind)
                 self._client._held_mark_expired(self._kind)
                 self._stop_event.wait(0.05)

@@ -405,6 +405,16 @@ def record_watch_expired(kind: str) -> None:
     ).inc(kind)
 
 
+def record_held_queue_overflow() -> None:
+    """The held-watch queue hit its cap (stalled CONSUMER, not a server
+    410 — a distinct counter so the two failure modes alert separately)."""
+    default_registry().counter(
+        "held_watch_queue_overflows_total",
+        "Held-watch queue overflows (consumer stopped draining; queue "
+        "cleared and a relist forced).",
+    ).inc()
+
+
 def set_held_queue_depth(depth: int) -> None:
     default_registry().gauge(
         "held_watch_queue_depth",
