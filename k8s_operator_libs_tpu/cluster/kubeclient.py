@@ -60,7 +60,12 @@ import tempfile
 import threading
 import time
 from collections import deque
-from http.client import HTTPConnection, HTTPResponse, HTTPSConnection
+from http.client import (
+    HTTPConnection,
+    HTTPException,
+    HTTPResponse,
+    HTTPSConnection,
+)
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import quote, urlencode, urlparse
 
@@ -1005,6 +1010,34 @@ class KubeApiClient:
         wanted = frozenset(kinds)
         for k in sorted(wanted):
             kind_info(k)  # fail fast on unregistered kinds, state untouched
+        # Seed every kind SYNCHRONOUSLY, before any watcher thread exists:
+        # the seed list pins the kind's bookmark in THIS thread, so a write
+        # issued after start_held_watches() returns is strictly past the
+        # bookmark and the stream replays it.  Seeding inside the watcher
+        # thread raced the caller's first write — a create landing before
+        # the thread's list was absorbed into the list RV and never
+        # delivered (the cache-sync-before-start contract of
+        # controller-runtime informers).  A seed list that fails (apiserver
+        # briefly down, 429/5xx) must not crash startup NOR hand seeding
+        # back to the watcher thread: the bookmark is pinned to 0 instead,
+        # so the stream opens with a full-journal replay (over-delivery,
+        # never loss) and the thread's own list can no longer absorb
+        # unconsumed writes (setdefault finds the key already present).
+        for k in sorted(wanted):
+            try:
+                self._seed_last_seen(k)
+            except (OSError, HTTPException, ValueError) as err:
+                # OSError: refused/reset; HTTPException: IncompleteRead/
+                # BadStatusLine from a server dying mid-response;
+                # ValueError: garbled JSON body.  All degrade, never crash.
+                logger.warning(
+                    "held watch %s: seed list failed (%s); "
+                    "stream will replay from journal start",
+                    k,
+                    err,
+                )
+            with self._last_seen_lock:
+                self._kind_bookmarks.setdefault(k, 0)
         self._held_kinds = wanted
         # Events stashed by a pre-held bounded-poll 410 (their bookmarks
         # already advanced past them) must flow into the held queue, or

@@ -18,6 +18,7 @@ clusters, upgrade_suit_test.go:87-93 / crdutil.go:56-67).
 
 import threading
 import time
+from http.client import IncompleteRead
 
 import pytest
 
@@ -1712,6 +1713,69 @@ class TestHeldWatchApiserverRestart:
                     for e in batch
                 )
             assert got_final
+        finally:
+            client.stop_held_watches()
+            facade.stop()
+
+    def test_first_write_after_start_is_never_lost(self):
+        """Regression: start_held_watches seeds bookmarks synchronously,
+        so a create issued the instant it returns is strictly past the
+        bookmark and must be delivered (was a race: the watcher thread's
+        own seed list could absorb the write)."""
+        for _ in range(5):
+            store = InMemoryCluster()
+            facade = ApiServerFacade(store).start()
+            client = KubeApiClient(KubeConfig(server=facade.url), timeout=10.0)
+            client.start_held_watches(("Node",), hold_seconds=3.0)
+            try:
+                client.create(make_node("n-first"))
+                assert client.wait_for_held_event(timeout=5.0)
+                events = client.events_since(0, kind=("Node",))
+                assert any(
+                    (e.new or {}).get("metadata", {}).get("name") == "n-first"
+                    for e in events
+                )
+            finally:
+                client.stop_held_watches()
+                facade.stop()
+
+    @pytest.mark.parametrize(
+        "injected",
+        [
+            ConnectionRefusedError("injected seed failure"),
+            IncompleteRead(b""),
+        ],
+        ids=["oserror", "httpexception"],
+    )
+    def test_seed_failure_degrades_to_full_replay(self, injected):
+        """A seed list that fails during start_held_watches must neither
+        crash startup nor reintroduce the lost-first-write race: the
+        bookmark is pinned to 0, the stream replays the journal, and the
+        caller's first write still arrives."""
+        store = InMemoryCluster()
+        facade = ApiServerFacade(store).start()
+        client = KubeApiClient(KubeConfig(server=facade.url), timeout=10.0)
+        real_list = client.list
+        calls = {"n": 0}
+
+        def failing_first_list(kind, *args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise injected
+            return real_list(kind, *args, **kwargs)
+
+        client.list = failing_first_list  # type: ignore[method-assign]
+        client.start_held_watches(("Node",), hold_seconds=3.0)  # no raise
+        try:
+            assert calls["n"] >= 1, "seed list was not attempted"
+            client.create(make_node("n-after-seed-fail"))
+            assert client.wait_for_held_event(timeout=5.0)
+            events = client.events_since(0, kind=("Node",))
+            assert any(
+                (e.new or {}).get("metadata", {}).get("name")
+                == "n-after-seed-fail"
+                for e in events
+            )
         finally:
             client.stop_held_watches()
             facade.stop()
