@@ -95,14 +95,31 @@ def run_real(args) -> int:
         )
     else:
         runnable = make_controller()
-    runnable.start()
-    print(
-        f"operator running against {client.config.server} "
-        f"(namespace {args.namespace}, selector {args.selector}"
-        + (", leader-elected" if args.ha else "")
-        + ") — Ctrl-C to stop"
-    )
+    # Ops endpoints (controller-runtime manager parity: /metrics on the
+    # manager's metrics port, /healthz + /readyz on its probe port —
+    # here one server carries all three).  Bind BEFORE starting the
+    # runnable: a bind failure (port taken) must abort before held
+    # watches open or a leader lease is acquired, not leak them.
+    ops = None
+    if args.ops_port is not None:
+        from k8s_operator_libs_tpu.controller import OpsServer
+
+        ops = OpsServer(port=args.ops_port, host=args.ops_host).start()
+        ops.add_health_check("controller", runnable.running)
+        # A hot HA standby is READY (it serves its purpose: being able
+        # to take over); readiness only fails when threads died.
+        ops.add_ready_check("replica", runnable.running)
+        print(f"ops endpoints on {ops.url} (/metrics /healthz /readyz)")
+    started = False
     try:
+        runnable.start()
+        started = True
+        print(
+            f"operator running against {client.config.server} "
+            f"(namespace {args.namespace}, selector {args.selector}"
+            + (", leader-elected" if args.ha else "")
+            + ") — Ctrl-C to stop"
+        )
         deadline = (
             time.monotonic() + args.run_seconds if args.run_seconds else None
         )
@@ -111,7 +128,10 @@ def run_real(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        runnable.stop()
+        if started:
+            runnable.stop()
+        if ops is not None:
+            ops.stop()
     return 0
 
 
@@ -131,6 +151,9 @@ class _HeldWatchRunnable:
     def stop(self, timeout: float = 10.0) -> None:
         self._controller.stop(timeout)
         self._client.stop_held_watches()
+
+    def running(self) -> bool:
+        return self._controller.running()
 
 
 def main() -> int:
@@ -165,6 +188,14 @@ def main() -> int:
         help="campaign identity for --ha (default: hostname-pid)",
     )
     parser.add_argument("--resync-seconds", type=float, default=30.0)
+    parser.add_argument(
+        "--ops-port",
+        type=int,
+        default=None,
+        help="serve /metrics /healthz /readyz on this port (0 = "
+        "ephemeral; omit to disable) — real-cluster mode only",
+    )
+    parser.add_argument("--ops-host", default="0.0.0.0")
     parser.add_argument(
         "--run-seconds",
         type=float,

@@ -1026,10 +1026,17 @@ class KubeApiClient:
         for k in sorted(wanted):
             try:
                 self._seed_last_seen(k)
-            except (OSError, HTTPException, ValueError) as err:
+            except (
+                OSError,
+                HTTPException,
+                ValueError,
+                ExecCredentialError,
+            ) as err:
                 # OSError: refused/reset; HTTPException: IncompleteRead/
                 # BadStatusLine from a server dying mid-response;
-                # ValueError: garbled JSON body.  All degrade, never crash.
+                # ValueError: garbled JSON body; ExecCredentialError: the
+                # GKE/EKS auth helper transiently failing.  All degrade,
+                # never crash — the watcher thread retries auth itself.
                 logger.warning(
                     "held watch %s: seed list failed (%s); "
                     "stream will replay from journal start",

@@ -9,6 +9,7 @@ from .controller import (
 )
 from .ha import DEFAULT_LOCK_NAME, HaOperator
 from .leader_election import LeaderElector
+from .ops_server import OpsServer
 from .upgrade_reconciler import (
     POLICY_KIND,
     UPGRADE_REQUEST,
@@ -28,6 +29,7 @@ __all__ = [
     "DEFAULT_LOCK_NAME",
     "HaOperator",
     "LeaderElector",
+    "OpsServer",
     "Reconciler",
     "Request",
     "Result",

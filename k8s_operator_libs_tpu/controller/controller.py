@@ -165,6 +165,15 @@ class Controller:
         for t in self._threads:
             t.join(max(0.0, deadline - time.monotonic()))
 
+    def running(self) -> bool:
+        """True while every controller thread (watcher, resyncer,
+        workers) is alive — the liveness probe the assembled operator's
+        /healthz serves (a dead watch loop means events stop flowing even
+        though the process is up)."""
+        if self._stop.is_set() or not self._threads:
+            return False
+        return all(t.is_alive() for t in self._threads)
+
     def wait_quiet(self, timeout: float = 10.0, settle: float = 0.05) -> bool:
         """Test helper: wait until there is no work at all — queued, being
         processed, or sitting in the delay heap — for *settle* seconds."""

@@ -90,6 +90,19 @@ class HaOperator:
         with self._lock:
             return self._controller
 
+    def running(self) -> bool:
+        """Liveness of this replica: the campaign thread must be alive,
+        and — while leading — so must the controller it promoted (a hot
+        standby with no controller is healthy; a leader whose controller
+        died is not)."""
+        if not self.elector.running():
+            return False
+        with self._lock:
+            controller = self._controller
+        if controller is None:
+            return True  # standby: alive and campaigning
+        return controller.running()
+
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:
         """Join the campaign; the controller starts if/when we lead."""

@@ -100,6 +100,11 @@ class LeaderElector:
         )
         self._thread.start()
 
+    def running(self) -> bool:
+        """True while the campaign thread is alive (liveness probe — a
+        dead elector on a standby means it would never take over)."""
+        return self._thread is not None and self._thread.is_alive()
+
     def stop(self, timeout: float = 5.0) -> None:
         """Stop campaigning; a leader steps down, then releases the lease
         for fast failover.  Order matters: ``on_stopped_leading`` (stop

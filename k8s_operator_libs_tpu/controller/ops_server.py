@@ -1,0 +1,161 @@
+"""Operational HTTP endpoints: /metrics, /healthz, /readyz.
+
+The reference's host operators inherit these from the controller-runtime
+manager, which serves Prometheus metrics on ``:8080/metrics`` and
+health/readiness probes on ``:8081/healthz`` + ``/readyz`` out of the box
+(SURVEY.md §1 L5 — the consumer layer the reference links into; the
+library itself stays transport-free, as does :mod:`..metrics`).  This
+module is that manager surface for this runtime: a tiny stdlib HTTP
+server exposing
+
+* ``GET /metrics``  — the process-default (or injected) registry in
+  Prometheus text exposition format 0.0.4;
+* ``GET /healthz``  — liveness: every registered health check must pass
+  (kubelet restarts the pod on failure);
+* ``GET /readyz``   — readiness: every registered ready check must pass
+  (the Service stops routing on failure; a hot HA standby is LIVE but
+  whether it reports READY is the consumer's choice of check).
+
+Checks are ``name -> callable`` returning True/None on success; a check
+that returns False or raises fails the probe, and the response body
+names each check's outcome (controller-runtime's verbose healthz
+format).  Failures answer 500 so kubelet/Service probes act on them.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from .. import metrics as metrics_mod
+
+logger = logging.getLogger(__name__)
+
+Check = Callable[[], object]
+
+
+class OpsServer:
+    """Serve /metrics, /healthz and /readyz for one operator process.
+
+    ``port=0`` binds an ephemeral port (tests); read :attr:`port` after
+    :meth:`start`.  The server runs daemon threads and never blocks the
+    operator; :meth:`stop` shuts it down and joins.
+    """
+
+    def __init__(
+        self,
+        port: int = 8080,
+        host: str = "0.0.0.0",
+        registry: Optional[metrics_mod.MetricsRegistry] = None,
+    ) -> None:
+        # All-interfaces default, like controller-runtime's metrics/probe
+        # listeners: kubelet probes and Prometheus scrapes arrive on the
+        # pod IP, so a loopback bind would fail every probe.
+        self._host = host
+        self._requested_port = port
+        self._registry = registry
+        self._health_checks: Dict[str, Check] = {}
+        self._ready_checks: Dict[str, Check] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- checks
+    def add_health_check(self, name: str, check: Check) -> None:
+        """Register a liveness check (all must pass for /healthz 200)."""
+        with self._lock:
+            self._health_checks[name] = check
+
+    def add_ready_check(self, name: str, check: Check) -> None:
+        """Register a readiness check (all must pass for /readyz 200)."""
+        with self._lock:
+            self._ready_checks[name] = check
+
+    def _run_checks(self, which: str) -> tuple:
+        """(all_passed, report_lines) for the named probe."""
+        with self._lock:
+            checks = dict(
+                self._health_checks if which == "healthz" else self._ready_checks
+            )
+        ok = True
+        lines = []
+        for name in sorted(checks):
+            try:
+                passed = checks[name]() is not False
+            except Exception as err:  # noqa: BLE001 — a probe must not crash
+                passed = False
+                lines.append(f"[-] {name}: {err}")
+            else:
+                lines.append(("[+] " if passed else "[-] ") + name)
+            ok = ok and passed
+        lines.append("ok" if ok else "failed")
+        return ok, lines
+
+    # ----------------------------------------------------------- lifecycle
+    @property
+    def port(self) -> int:
+        """The bound port (resolves 0 after start)."""
+        if self._server is not None:
+            return self._server.server_address[1]
+        return self._requested_port
+
+    @property
+    def url(self) -> str:
+        """Base URL reachable from THIS host (an all-interfaces bind is
+        addressed via loopback for local probes/tests)."""
+        host = "127.0.0.1" if self._host in ("0.0.0.0", "::") else self._host
+        return f"http://{host}:{self.port}"
+
+    def start(self) -> "OpsServer":
+        if self._server is not None:
+            raise RuntimeError("ops server already started")
+        ops = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: D102 — quiet
+                logger.debug("ops: " + fmt, *args)
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    reg = ops._registry or metrics_mod.default_registry()
+                    body = reg.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif path in ("/healthz", "/readyz"):
+                    ok, lines = ops._run_checks(path.lstrip("/"))
+                    body = ("\n".join(lines) + "\n").encode()
+                    self.send_response(200 if ok else 500)
+                    self.send_header("Content-Type", "text/plain; charset=utf-8")
+                else:
+                    body = b"404 not found\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((self._host, self._requested_port), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="ops-server",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+        self._server = None
+        self._thread = None

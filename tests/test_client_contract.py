@@ -25,6 +25,7 @@ import pytest
 from k8s_operator_libs_tpu.cluster import (
     ApiServerFacade,
     ConflictError,
+    ExecCredentialError,
     ExpiredError,
     InMemoryCluster,
     KubeApiClient,
@@ -1744,8 +1745,9 @@ class TestHeldWatchApiserverRestart:
         [
             ConnectionRefusedError("injected seed failure"),
             IncompleteRead(b""),
+            ExecCredentialError("auth helper transiently failing"),
         ],
-        ids=["oserror", "httpexception"],
+        ids=["oserror", "httpexception", "execauth"],
     )
     def test_seed_failure_degrades_to_full_replay(self, injected):
         """A seed list that fails during start_held_watches must neither

@@ -638,3 +638,123 @@ class TestCrDrivenPolicy:
                 DRIVER_LABELS,
                 policy_source=UpgradePolicySpec(auto_upgrade=True),
             )
+
+
+class TestOpsServer:
+    """The controller-runtime manager's /metrics + /healthz + /readyz
+    surface (SURVEY §1 L5: consumers get these from the manager; here
+    OpsServer supplies them for the assembled operator)."""
+
+    def _get(self, url):
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                return resp.status, resp.read().decode(), dict(resp.headers)
+        except urllib.error.HTTPError as err:
+            return err.code, err.read().decode(), dict(err.headers)
+
+    def test_metrics_endpoint_serves_registry(self):
+        from k8s_operator_libs_tpu import metrics
+        from k8s_operator_libs_tpu.controller import OpsServer
+
+        registry = metrics.MetricsRegistry()
+        registry.counter("ops_test_total", "test counter").inc()
+        srv = OpsServer(port=0, registry=registry).start()
+        try:
+            status, body, headers = self._get(srv.url + "/metrics")
+            assert status == 200
+            assert "0.0.4" in headers.get("Content-Type", "")
+            assert "ops_test_total 1" in body
+        finally:
+            srv.stop()
+
+    def test_metrics_default_registry(self):
+        from k8s_operator_libs_tpu import metrics
+        from k8s_operator_libs_tpu.controller import OpsServer
+
+        registry = metrics.MetricsRegistry()
+        prev = metrics.set_default_registry(registry)
+        srv = OpsServer(port=0).start()
+        try:
+            registry.gauge("ops_default_gauge", "g").set(7)
+            status, body, _ = self._get(srv.url + "/metrics")
+            assert status == 200
+            assert "ops_default_gauge 7" in body
+        finally:
+            srv.stop()
+            metrics.set_default_registry(prev)
+
+    def test_healthz_and_readyz_pass_and_fail(self):
+        from k8s_operator_libs_tpu.controller import OpsServer
+
+        srv = OpsServer(port=0).start()
+        try:
+            # no checks registered: vacuously healthy/ready
+            for path in ("/healthz", "/readyz"):
+                status, body, _ = self._get(srv.url + path)
+                assert status == 200
+                assert body.strip().endswith("ok")
+
+            srv.add_health_check("alive", lambda: True)
+            srv.add_ready_check("leading", lambda: False)
+            status, body, _ = self._get(srv.url + "/healthz")
+            assert status == 200
+            assert "[+] alive" in body
+            status, body, _ = self._get(srv.url + "/readyz")
+            assert status == 500
+            assert "[-] leading" in body and body.strip().endswith("failed")
+        finally:
+            srv.stop()
+
+    def test_raising_check_fails_probe_with_reason(self):
+        from k8s_operator_libs_tpu.controller import OpsServer
+
+        srv = OpsServer(port=0).start()
+        try:
+            def boom():
+                raise RuntimeError("cache not synced")
+
+            srv.add_ready_check("informer", boom)
+            status, body, _ = self._get(srv.url + "/readyz")
+            assert status == 500
+            assert "[-] informer: cache not synced" in body
+        finally:
+            srv.stop()
+
+    def test_unknown_path_404(self):
+        from k8s_operator_libs_tpu.controller import OpsServer
+
+        srv = OpsServer(port=0).start()
+        try:
+            status, _, _ = self._get(srv.url + "/nope")
+            assert status == 404
+        finally:
+            srv.stop()
+
+    def test_stop_is_idempotent_and_restart_refused(self):
+        from k8s_operator_libs_tpu.controller import OpsServer
+
+        srv = OpsServer(port=0).start()
+        srv.stop()
+        srv.stop()  # no raise
+        srv2 = OpsServer(port=0).start()
+        try:
+            with pytest.raises(RuntimeError, match="already started"):
+                srv2.start()
+        finally:
+            srv2.stop()
+
+    def test_running_probe_tracks_lifecycle(self):
+        """Controller.running() is the /healthz liveness source: False
+        before start, True while the threads run, False after stop."""
+        cluster = InMemoryCluster()
+        ctrl = Controller(cluster, _CountingReconciler()).watches("Node")
+        assert not ctrl.running()
+        ctrl.start()
+        try:
+            assert ctrl.running()
+        finally:
+            ctrl.stop()
+        assert not ctrl.running()
