@@ -5,12 +5,22 @@ Subcommands:
 * ``status`` — compute and print the rollout status
   (:mod:`.upgrade.rollout_status`) from a persisted cluster dump (the
   ``--state-file`` JSON the example CLIs write, see
-  ``examples/apply_crds.py``).  The reference has no equivalent;
-  consumers grep node labels by hand.
+  ``examples/apply_crds.py``) or live via ``--kubeconfig``/
+  ``--in-cluster``.  The reference has no equivalent; consumers grep
+  node labels by hand.
 
       python -m k8s_operator_libs_tpu status --state-file /tmp/cluster.json \\
           --namespace tpu-ops --selector app=tpu-runtime --component tpu-runtime
       python -m k8s_operator_libs_tpu status --state-file ... --json
+
+* ``plan`` — dry-run the rollout (:mod:`.upgrade.plan`): simulate the
+  next reconcile cycles on a sandbox clone and print which nodes would
+  be admitted, every projected transition, and the admission gates —
+  without writing anything to the source.
+
+      python -m k8s_operator_libs_tpu plan --state-file /tmp/cluster.json \\
+          --policy fleet-policy --cycles 5
+      python -m k8s_operator_libs_tpu plan --kubeconfig --policy fleet-policy
 """
 
 from __future__ import annotations
@@ -18,6 +28,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+from typing import Optional, Tuple
 
 from .cluster.inmem import InMemoryCluster
 from .upgrade import util
@@ -38,102 +49,133 @@ def _parse_selector_arg(selector: str) -> dict:
     return labels
 
 
-def cmd_status(args: argparse.Namespace) -> int:
+def _open_source(args: argparse.Namespace, cmd: str) -> Tuple[Optional[object], int]:
+    """Resolve the ONE cluster source (--state-file | --kubeconfig |
+    --in-cluster) shared by the read-only subcommands.  Returns
+    (cluster, 0) or (None, exit_code)."""
     if (args.kubeconfig is not None or args.in_cluster) and args.state_file:
         print(
-            "status takes ONE source: --state-file or "
+            f"{cmd} takes ONE source: --state-file or "
             "--kubeconfig/--in-cluster, not both",
             file=sys.stderr,
         )
-        return 2
+        return None, 2
     if args.kubeconfig is not None or args.in_cluster:
-        # Live mode: compute the status from a real cluster through
-        # KubeApiClient (same client surface as the operator).
+        # Live mode: read through KubeApiClient (same client surface as
+        # the operator).
         from .cluster import KubeApiClient, KubeConfig, KubeConfigError
 
         try:
             if args.in_cluster:
-                cluster = KubeApiClient(KubeConfig.in_cluster())
-            else:
-                cluster = KubeApiClient(
+                return KubeApiClient(KubeConfig.in_cluster()), 0
+            return (
+                KubeApiClient(
                     KubeConfig.load(args.kubeconfig or None, context=args.context)
-                )
+                ),
+                0,
+            )
         except KubeConfigError as err:
             print(f"cannot load cluster config: {err}", file=sys.stderr)
-            return 2
-    elif args.state_file:
+            return None, 2
+    if args.state_file:
         try:
             with open(args.state_file, "r", encoding="utf-8") as fh:
-                cluster = InMemoryCluster.from_dict(json.load(fh))
+                return InMemoryCluster.from_dict(json.load(fh)), 0
         except FileNotFoundError:
             print(f"state file not found: {args.state_file}", file=sys.stderr)
-            return 2
+            return None, 2
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as err:
             print(
                 f"state file {args.state_file} is not a cluster dump: {err}",
                 file=sys.stderr,
             )
-            return 2
-    else:
+            return None, 2
+    print(
+        f"{cmd} needs a source: --state-file DUMP, --kubeconfig "
+        "[PATH], or --in-cluster",
+        file=sys.stderr,
+    )
+    return None, 2
+
+
+def _load_policy_cr(
+    args: argparse.Namespace, cluster
+) -> Tuple[Optional[object], int]:
+    """Load + validate the TpuUpgradePolicy CR named by --policy.
+    Returns (policy | None, exit_code); a missing CR is (None, 0) with a
+    note (callers decide whether that is fatal), an invalid CR is fatal."""
+    if not args.policy:
+        return None, 0
+    from .api import UpgradePolicySpec, ValidationError
+    from .cluster.errors import ApiError, NotFoundError
+
+    try:
+        cr = cluster.get("TpuUpgradePolicy", args.policy, args.namespace)
+    except NotFoundError:
         print(
-            "status needs a source: --state-file DUMP, --kubeconfig "
-            "[PATH], or --in-cluster",
+            f"TpuUpgradePolicy {args.namespace}/{args.policy} not found "
+            f"in the source",
             file=sys.stderr,
         )
-        return 2
+        return None, 0
+    except (ApiError, OSError) as err:
+        print(
+            f"cannot read TpuUpgradePolicy {args.namespace}/"
+            f"{args.policy}: {err}",
+            file=sys.stderr,
+        )
+        return None, 0
+    try:
+        policy = UpgradePolicySpec.from_dict(cr.get("spec") or {})
+        policy.validate()
+    except ValidationError as err:
+        print(
+            f"TpuUpgradePolicy {args.namespace}/{args.policy} is "
+            f"invalid: {err}",
+            file=sys.stderr,
+        )
+        return None, 2
+    return policy, 0
+
+
+def _push_topology_keys(policy) -> None:
+    # The domain table and canary census must use the policy's topology
+    # keys — same push the live scheduler gets via _configure_from_policy,
+    # or status/plan and the scheduler would disagree.
+    from .tpu import topology
+
+    topology.set_label_keys(
+        policy.slice_label_keys, policy.multislice_label_keys
+    )
+
+
+def cmd_status(args: argparse.Namespace) -> int:
+    cluster, rc = _open_source(args, "status")
+    if cluster is None:
+        return rc
     util.set_component_name(args.component)
     from .cluster.errors import ApiError
+    from .upgrade.upgrade_state import UpgradeStateError
 
     manager = ClusterUpgradeStateManager(cluster)
     try:
         state = manager.build_state(
             args.namespace, _parse_selector_arg(args.selector)
         )
-    except (ApiError, OSError) as err:
-        # Live mode: unreachable apiserver / auth failure / 5xx must keep
-        # the documented exit-code contract (2 = cannot read the source),
-        # not escape as a traceback.
+    except (ApiError, OSError, UpgradeStateError) as err:
+        # Unreachable apiserver / auth failure / 5xx / inconsistent
+        # snapshot (unscheduled driver pods) must keep the documented
+        # exit-code contract (2 = cannot read the source), not escape as
+        # a traceback.
         print(f"cannot read cluster state: {err}", file=sys.stderr)
         return 2
-    policy = None
-    if args.policy:
-        from .api import UpgradePolicySpec, ValidationError
-        from .cluster.errors import NotFoundError
-
-        try:
-            cr = cluster.get("TpuUpgradePolicy", args.policy, args.namespace)
-        except NotFoundError:
-            print(
-                f"TpuUpgradePolicy {args.namespace}/{args.policy} not found "
-                f"in the dump; gates not evaluated",
-                file=sys.stderr,
-            )
-        except (ApiError, OSError) as err:
-            print(
-                f"cannot read TpuUpgradePolicy {args.namespace}/"
-                f"{args.policy}: {err}; gates not evaluated",
-                file=sys.stderr,
-            )
-        else:
-            try:
-                policy = UpgradePolicySpec.from_dict(cr.get("spec") or {})
-                policy.validate()
-            except ValidationError as err:
-                print(
-                    f"TpuUpgradePolicy {args.namespace}/{args.policy} is "
-                    f"invalid: {err}",
-                    file=sys.stderr,
-                )
-                return 2
+    policy, rc = _load_policy_cr(args, cluster)
+    if rc:
+        return rc
+    if args.policy and policy is None:
+        print("gates not evaluated", file=sys.stderr)
     if policy is not None:
-        # The domain table and canary census must use the policy's
-        # topology keys — same push the live scheduler gets via
-        # _configure_from_policy, or status and scheduler would disagree.
-        from .tpu import topology
-
-        topology.set_label_keys(
-            policy.slice_label_keys, policy.multislice_label_keys
-        )
+        _push_topology_keys(policy)
     status = RolloutStatus.from_cluster_state(state, policy=policy)
     if args.json:
         print(json.dumps(status.to_dict()))
@@ -144,6 +186,90 @@ def cmd_status(args: argparse.Namespace) -> int:
     return 0 if status.complete or not args.wait_exit_code else 3
 
 
+def cmd_plan(args: argparse.Namespace) -> int:
+    cluster, rc = _open_source(args, "plan")
+    if cluster is None:
+        return rc
+    util.set_component_name(args.component)
+    from .api import UpgradePolicySpec
+    from .cluster.errors import ApiError
+    from .upgrade.plan import plan_rollout
+    from .upgrade.upgrade_state import UpgradeStateError
+
+    policy, rc = _load_policy_cr(args, cluster)
+    if rc:
+        return rc
+    if args.policy and policy is None:
+        # Unlike `status` (where a missing policy only skips the gate
+        # annotations), the policy determines the ENTIRE projection — a
+        # plan for the wrong policy is a wrong blast-radius answer.
+        print(
+            f"cannot plan: --policy {args.policy} could not be loaded",
+            file=sys.stderr,
+        )
+        return 2
+    if policy is None:
+        policy = UpgradePolicySpec(auto_upgrade=True)
+        print(
+            "note: planning with reference-default policy "
+            "(maxParallelUpgrades=1, maxUnavailable=25%); pass --policy "
+            "to plan a TpuUpgradePolicy CR",
+            file=sys.stderr,
+        )
+    _push_topology_keys(policy)
+    try:
+        if isinstance(cluster, InMemoryCluster):
+            dump = cluster.to_dict()
+        else:
+            # Live source: one read-only snapshot; the simulation runs
+            # entirely on the clone and never writes back.
+            snap = cluster.snapshot()
+            dump = {"rv": 0, "objects": list(snap.values())}
+        plan = plan_rollout(
+            dump,
+            args.namespace,
+            _parse_selector_arg(args.selector),
+            policy,
+            cycles=args.cycles,
+        )
+    except (ApiError, OSError, UpgradeStateError) as err:
+        print(f"cannot plan from cluster state: {err}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(plan.to_dict()))
+    else:
+        print(plan.render())
+    return 0
+
+
+def _add_source_args(sp: argparse.ArgumentParser) -> None:
+    sp.add_argument(
+        "--state-file", default="", help="cluster dump JSON (offline mode)"
+    )
+    sp.add_argument(
+        "--kubeconfig",
+        nargs="?",
+        const="",
+        default=None,
+        help="live mode against a real cluster (no value = $KUBECONFIG "
+        "then ~/.kube/config)",
+    )
+    sp.add_argument("--context", default=None)
+    sp.add_argument("--in-cluster", action="store_true")
+    sp.add_argument("--namespace", default="tpu-ops")
+    sp.add_argument(
+        "--selector",
+        default="app=tpu-runtime",
+        help="driver DaemonSet label selector, key=value[,key=value...]",
+    )
+    sp.add_argument(
+        "--component",
+        default="tpu-runtime",
+        help="managed component name (parameterizes the label keys)",
+    )
+    sp.add_argument("--json", action="store_true", help="machine output")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m k8s_operator_libs_tpu",
@@ -152,44 +278,41 @@ def main(argv=None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
 
     st = sub.add_parser("status", help="print rollout status")
-    st.add_argument(
-        "--state-file", default="", help="cluster dump JSON (offline mode)"
-    )
-    st.add_argument(
-        "--kubeconfig",
-        nargs="?",
-        const="",
-        default=None,
-        help="live mode against a real cluster (no value = $KUBECONFIG "
-        "then ~/.kube/config)",
-    )
-    st.add_argument("--context", default=None)
-    st.add_argument("--in-cluster", action="store_true")
-    st.add_argument("--namespace", default="tpu-ops")
-    st.add_argument(
-        "--selector",
-        default="app=tpu-runtime",
-        help="driver DaemonSet label selector, key=value[,key=value...]",
-    )
-    st.add_argument(
-        "--component",
-        default="tpu-runtime",
-        help="managed component name (parameterizes the label keys)",
-    )
+    _add_source_args(st)
     st.add_argument(
         "--policy",
         default="",
-        help="TpuUpgradePolicy name in the dump; when set, the admission "
+        help="TpuUpgradePolicy name in the source; when set, the admission "
         "gates (canary/window/pacing) are evaluated and any freeze is "
         "explained",
     )
-    st.add_argument("--json", action="store_true", help="machine output")
     st.add_argument(
         "--wait-exit-code",
         action="store_true",
         help="exit 3 while the rollout is incomplete (poll-friendly)",
     )
     st.set_defaults(func=cmd_status)
+
+    pl = sub.add_parser(
+        "plan",
+        help="dry-run: simulate the next reconcile cycles, print projected "
+        "admissions/transitions and gates; never writes",
+    )
+    _add_source_args(pl)
+    pl.add_argument(
+        "--policy",
+        default="",
+        help="TpuUpgradePolicy name in the source to plan with "
+        "(default: reference-default policy)",
+    )
+    pl.add_argument(
+        "--cycles",
+        type=int,
+        default=0,
+        help="simulation horizon in reconcile cycles (0 = until "
+        "convergence or steady state, capped)",
+    )
+    pl.set_defaults(func=cmd_plan)
 
     args = parser.parse_args(argv)
     try:

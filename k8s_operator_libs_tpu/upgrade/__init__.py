@@ -36,6 +36,7 @@ from .upgrade_requestor import (
     get_requestor_opts_from_envs,
     new_requestor_id_predicate,
 )
+from .plan import PlannedTransition, RolloutPlan, plan_rollout
 from .rollout_status import DomainStatus, GateStatus, RolloutStatus
 from .upgrade_state import ClusterUpgradeStateManager, UpgradeStateError
 from .util import ClusterEventRecorder, EventRecorder, log_event
@@ -78,4 +79,7 @@ __all__ = [
     "DomainStatus",
     "GateStatus",
     "RolloutStatus",
+    "PlannedTransition",
+    "RolloutPlan",
+    "plan_rollout",
 ]

@@ -274,10 +274,17 @@ class DrainManager:
         # Shared with PodManager when assembled by ClusterUpgradeStateManager
         # (one pool per operator, not per manager).  Threads spawn lazily,
         # so idle managers cost nothing.
+        self._owns_pool = pool is None
         self._pool = pool or ThreadPoolExecutor(
             max_workers=DEFAULT_WORKER_POOL_SIZE,
             thread_name_prefix="drain-worker",
         )
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Release worker threads (short-lived managers: plan sandboxes,
+        tests).  An injected pool belongs to the assembler."""
+        if self._owns_pool:
+            self._pool.shutdown(wait=wait)
 
     @property
     def in_flight(self) -> StringSet:

@@ -81,6 +81,7 @@ class PodManager:
         # one bounded pool per operator.  The reference spawns a goroutine
         # per node (pod_manager.go:164-223, 275-312); a 1,000-node
         # pod-deletion wave here queues on a few dozen threads instead.
+        self._owns_pool = pool is None
         self._pool = pool or ThreadPoolExecutor(
             max_workers=DEFAULT_WORKER_POOL_SIZE,
             thread_name_prefix="pod-worker",
@@ -92,6 +93,14 @@ class PodManager:
         self._check_pool = ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="pod-check"
         )
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Release worker threads.  Embedders running short-lived managers
+        (the plan sandbox, tests) call this; a pool injected by the
+        assembler is the assembler's to shut down."""
+        self._check_pool.shutdown(wait=wait)
+        if self._owns_pool:
+            self._pool.shutdown(wait=wait)
 
     def set_pod_deletion_filter(self, pod_deletion_filter: PodDeletionFilter) -> None:
         """Install the consumer's eviction predicate (reference passes it to

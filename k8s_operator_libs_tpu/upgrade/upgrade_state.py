@@ -103,6 +103,7 @@ class ClusterUpgradeStateManager:
                 max_workers=DEFAULT_WORKER_POOL_SIZE,
                 thread_name_prefix="upgrade-worker",
             )
+        self._owned_pool = shared_pool
         self._drain_manager = drain_manager or DrainManager(
             cluster,
             self._provider,
@@ -134,6 +135,20 @@ class ClusterUpgradeStateManager:
         self._inplace: Optional[InplaceNodeStateManager] = None
         self._requestor = requestor
         self._use_maintenance_operator = use_maintenance_operator
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Release the worker-pool threads this manager owns.  Long-lived
+        operators never need this (pools live as long as the process);
+        short-lived managers — the plan sandbox, consumers embedding a
+        manager per request — call it to avoid accumulating idle
+        threads.  Injected managers/pools belong to their creators and
+        are left alone."""
+        for mgr in (self._drain_manager, self._pod_manager):
+            fn = getattr(mgr, "shutdown", None)
+            if callable(fn):
+                fn(wait)
+        if self._owned_pool is not None:
+            self._owned_pool.shutdown(wait=wait)
 
     # ------------------------------------------------------------- builders
     def with_pod_deletion_enabled(

@@ -1,0 +1,453 @@
+"""Dry-run rollout planning (upgrade/plan.py).
+
+The planner's whole value is fidelity: it runs the REAL state machine on
+a sandbox clone, so the no-drift property (plan == what apply_state
+actually does) and the no-mutation property (the source is never
+touched) are the core specs here, alongside gate reporting and
+multi-cycle projection."""
+
+from __future__ import annotations
+
+import copy
+import json
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DrainSpec, IntOrString, UpgradePolicySpec
+from k8s_operator_libs_tpu.cluster import InMemoryCluster
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    consts,
+    plan_rollout,
+    util,
+)
+
+from harness import DRIVER_LABELS, NAMESPACE, Fleet
+
+
+def _policy(**kwargs) -> UpgradePolicySpec:
+    kwargs.setdefault("auto_upgrade", True)
+    kwargs.setdefault(
+        "drain_spec", DrainSpec(enable=True, force=True, timeout_second=60)
+    )
+    return UpgradePolicySpec(**kwargs)
+
+
+def _fleet(n_slices=3, hosts=2) -> tuple:
+    cluster = InMemoryCluster()
+    fleet = Fleet(cluster, revision_hash="v1")
+    for s in range(n_slices):
+        for h in range(hosts):
+            fleet.add_node(
+                f"slice{s}-host{h}",
+                labels={consts.SLICE_ID_LABEL_KEYS[0]: f"slice-{s}"},
+            )
+    fleet.publish_new_revision("v2")
+    return cluster, fleet
+
+
+class TestPlanCore:
+    def test_next_admissions_respect_throttle(self):
+        cluster, _ = _fleet()
+        plan = plan_rollout(
+            cluster.to_dict(),
+            NAMESPACE,
+            dict(DRIVER_LABELS),
+            _policy(max_parallel_upgrades=1, max_unavailable=IntOrString("100%")),
+            cycles=2,
+        )
+        # maxParallel=1, node-granular: exactly one admission predicted
+        assert len(plan.next_admissions) == 1
+        assert plan.cycles_simulated == 2
+        assert not plan.converged
+
+    def test_slice_aware_admits_whole_domain(self):
+        cluster, _ = _fleet()
+        plan = plan_rollout(
+            cluster.to_dict(),
+            NAMESPACE,
+            dict(DRIVER_LABELS),
+            _policy(
+                max_parallel_upgrades=1,
+                max_unavailable=IntOrString("100%"),
+                slice_aware=True,
+            ),
+            cycles=2,
+        )
+        admitted = plan.next_admissions
+        assert len(admitted) == 2  # both hosts of one slice co-scheduled
+        assert len({n.split("-")[0] for n in admitted}) == 1
+
+    def test_projection_converges_to_done(self):
+        cluster, _ = _fleet(n_slices=2)
+        plan = plan_rollout(
+            cluster.to_dict(),
+            NAMESPACE,
+            dict(DRIVER_LABELS),
+            _policy(
+                max_parallel_upgrades=0,
+                max_unavailable=IntOrString("100%"),
+                slice_aware=True,
+            ),
+        )
+        assert plan.converged, plan.render()
+        assert plan.projected_states == {consts.UPGRADE_STATE_DONE: 4}
+        # every node passed through the full lifecycle in the projection
+        nodes_seen = {t.node for t in plan.transitions}
+        assert len(nodes_seen) == 4
+
+    def test_source_is_never_mutated(self):
+        cluster, _ = _fleet()
+        dump = cluster.to_dict()
+        pristine = copy.deepcopy(dump)
+        plan_rollout(
+            cluster.to_dict(),
+            NAMESPACE,
+            dict(DRIVER_LABELS),
+            _policy(max_parallel_upgrades=0, max_unavailable=IntOrString("100%")),
+        )
+        assert json.dumps(dump, sort_keys=True) == json.dumps(
+            pristine, sort_keys=True
+        )
+        # and the live source cluster still has every node upgrade-less
+        key = util.get_upgrade_state_label_key()
+        for node in cluster.list("Node"):
+            labels = (node.get("metadata") or {}).get("labels") or {}
+            assert key not in labels
+
+    def test_no_drift_plan_cycle_matches_real_apply(self):
+        """The fidelity contract: cycle-1 planned transitions equal the
+        transitions a REAL manager makes on an identical twin cluster."""
+        policy = _policy(
+            max_parallel_upgrades=2, max_unavailable=IntOrString("50%")
+        )
+        cluster, _ = _fleet()
+        plan = plan_rollout(
+            cluster.to_dict(), NAMESPACE, dict(DRIVER_LABELS), policy, cycles=1
+        )
+
+        # replay for real on the twin
+        manager = ClusterUpgradeStateManager(cluster)
+        state = manager.build_state(NAMESPACE, dict(DRIVER_LABELS))
+        manager.apply_state(state, policy)
+        manager.drain_manager.wait_idle(10.0)
+        manager.pod_manager.wait_idle(10.0)
+        key = util.get_upgrade_state_label_key()
+        real = {
+            (n["metadata"].get("labels") or {}).get(key, "")
+            and n["metadata"]["name"]: (n["metadata"].get("labels") or {}).get(
+                key, ""
+            )
+            for n in cluster.list("Node")
+        }
+        real.pop("", None)
+        planned = {
+            t.node: t.to_state for t in plan.transitions if t.cycle == 1
+        }
+        assert planned == {k: v for k, v in real.items() if v}
+
+    def test_blocked_rollout_reaches_steady_state(self):
+        cluster, _ = _fleet()
+        plan = plan_rollout(
+            cluster.to_dict(),
+            NAMESPACE,
+            dict(DRIVER_LABELS),
+            _policy(max_parallel_upgrades=0, max_unavailable=IntOrString(0)),
+        )
+        assert plan.steady_state and not plan.converged
+        assert plan.next_admissions == []
+
+    def test_auto_upgrade_off_plans_nothing(self):
+        cluster, _ = _fleet()
+        plan = plan_rollout(
+            cluster.to_dict(),
+            NAMESPACE,
+            dict(DRIVER_LABELS),
+            _policy(auto_upgrade=False),
+        )
+        assert plan.transitions == []
+        assert plan.steady_state
+
+
+class TestPlanGates:
+    def test_frozen_canary_gate_reported(self):
+        cluster, fleet = _fleet(n_slices=3)
+        policy = _policy(
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            slice_aware=True,
+            canary_domains=1,
+        )
+        # run the canary admission for real (cycle 1 classifies, cycle 2
+        # admits + stamps the canary domain), then fail its nodes
+        manager = ClusterUpgradeStateManager(cluster)
+        for _ in range(2):
+            state = manager.build_state(NAMESPACE, dict(DRIVER_LABELS))
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10.0)
+            manager.pod_manager.wait_idle(10.0)
+        key = util.get_upgrade_state_label_key()
+        failed_any = False
+        for node in cluster.list("Node"):
+            labels = (node.get("metadata") or {}).get("labels") or {}
+            if labels.get(key) and labels[key] != consts.UPGRADE_STATE_DONE:
+                labels[key] = consts.UPGRADE_STATE_FAILED
+                node["metadata"]["labels"] = labels
+                cluster.update(node)
+                failed_any = True
+        assert failed_any
+
+        plan = plan_rollout(
+            cluster.to_dict(), NAMESPACE, dict(DRIVER_LABELS), policy, cycles=1
+        )
+        gates = {g.gate: g for g in plan.gates}
+        assert gates["canary"].blocking
+        assert plan.next_admissions == []
+
+    def test_closed_window_gate_reported(self):
+        cluster, _ = _fleet()
+        # a 1-minute window starting 12h from now is closed at planning time
+        from datetime import datetime, timedelta, timezone
+
+        from k8s_operator_libs_tpu.api import MaintenanceWindowSpec
+
+        far = datetime.now(timezone.utc) + timedelta(hours=12)
+        policy = _policy(
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            maintenance_window=MaintenanceWindowSpec(
+                start=far.strftime("%H:%M"), duration_minutes=1
+            ),
+        )
+        plan = plan_rollout(
+            cluster.to_dict(), NAMESPACE, dict(DRIVER_LABELS), policy, cycles=1
+        )
+        gates = {g.gate: g for g in plan.gates}
+        assert gates["maintenanceWindow"].blocking
+        assert plan.next_admissions == []
+
+
+class TestPlanRender:
+    def test_render_and_dict_shapes(self):
+        cluster, _ = _fleet(n_slices=2)
+        plan = plan_rollout(
+            cluster.to_dict(),
+            NAMESPACE,
+            dict(DRIVER_LABELS),
+            _policy(
+                max_parallel_upgrades=0,
+                max_unavailable=IntOrString("100%"),
+                slice_aware=True,
+            ),
+        )
+        text = plan.render()
+        assert "Next admissions" in text
+        assert "Cycle 1:" in text
+        d = plan.to_dict()
+        assert d["converged"] is True
+        assert isinstance(d["transitions"], list)
+        assert d["nextAdmissions"]
+        round_trip = json.dumps(d)
+        assert json.loads(round_trip) == d
+
+
+class TestPlanCli:
+    def _dump(self, cluster, tmp_path):
+        path = tmp_path / "cluster.json"
+        path.write_text(json.dumps(cluster.to_dict()))
+        return str(path)
+
+    def test_plan_table_output(self, tmp_path, capsys):
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+
+        cluster, _ = _fleet(n_slices=2)
+        rc = cli_main(["plan", "--state-file", self._dump(cluster, tmp_path)])
+        captured = capsys.readouterr()
+        assert rc == 0
+        assert "Next admissions" in captured.out
+        assert "Cycle 1:" in captured.out
+        assert "reference-default policy" in captured.err
+
+    def test_plan_json_with_policy_cr(self, tmp_path, capsys):
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+
+        cluster, _ = _fleet(n_slices=2)
+        cluster.create(
+            {
+                "kind": "TpuUpgradePolicy",
+                "metadata": {"name": "fleet-policy", "namespace": NAMESPACE},
+                "spec": {
+                    "autoUpgrade": True,
+                    "maxParallelUpgrades": 0,
+                    "maxUnavailable": "100%",
+                    "sliceAware": True,
+                },
+            }
+        )
+        rc = cli_main(
+            [
+                "plan",
+                "--state-file",
+                self._dump(cluster, tmp_path),
+                "--policy",
+                "fleet-policy",
+                "--json",
+            ]
+        )
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert data["converged"] is True
+        # slice-aware 100%: both slices admitted in the first admitting cycle
+        assert len(data["nextAdmissions"]) == 4
+
+    def test_plan_never_writes_to_state_file(self, tmp_path, capsys):
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+
+        cluster, _ = _fleet(n_slices=2)
+        path = self._dump(cluster, tmp_path)
+        before = open(path).read()
+        rc = cli_main(["plan", "--state-file", path])
+        assert rc == 0
+        assert open(path).read() == before
+
+    def test_plan_live_mode_reads_only(self, tmp_path, capsys):
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+        from k8s_operator_libs_tpu.cluster import ApiServerFacade
+
+        cluster, _ = _fleet(n_slices=2)
+        rv_before = cluster.journal_seq()
+        with ApiServerFacade(cluster) as facade:
+            kubeconfig = tmp_path / "kubeconfig"
+            kubeconfig.write_text(
+                "\n".join(
+                    [
+                        "apiVersion: v1",
+                        "kind: Config",
+                        "current-context: test",
+                        "contexts:",
+                        "- name: test",
+                        "  context: {cluster: test, user: test}",
+                        "clusters:",
+                        "- name: test",
+                        f"  cluster: {{server: {facade.url}}}",
+                        "users:",
+                        "- name: test",
+                        "  user: {token: dummy}",
+                    ]
+                )
+            )
+            rc = cli_main(
+                ["plan", "--kubeconfig", str(kubeconfig), "--json"]
+            )
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert data["transitions"]
+        # read-only: no write advanced the source cluster's RV
+        assert cluster.journal_seq() == rv_before
+
+    def test_plan_cycles_flag_caps_horizon(self, tmp_path, capsys):
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+
+        cluster, _ = _fleet(n_slices=2)
+        rc = cli_main(
+            [
+                "plan",
+                "--state-file",
+                self._dump(cluster, tmp_path),
+                "--cycles",
+                "1",
+                "--json",
+            ]
+        )
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert data["cyclesSimulated"] == 1
+        assert data["converged"] is False
+
+
+class TestPlanReviewRegressions:
+    """Fixes from review: bystander nodes, explicit-policy failures,
+    snapshot-inconsistency exit codes, and sandbox thread cleanup."""
+
+    def test_bystander_nodes_do_not_block_convergence(self):
+        """A cluster has nodes that never host driver pods (control
+        plane, CPU pools); they must not keep the plan from converging."""
+        cluster, _ = _fleet(n_slices=2)
+        from k8s_operator_libs_tpu.cluster.objects import make_node
+
+        cluster.create(make_node("control-plane-0"))
+        cluster.create(make_node("cpu-pool-7"))
+        plan = plan_rollout(
+            cluster.to_dict(),
+            NAMESPACE,
+            dict(DRIVER_LABELS),
+            _policy(
+                max_parallel_upgrades=0,
+                max_unavailable=IntOrString("100%"),
+                slice_aware=True,
+            ),
+        )
+        assert plan.converged, plan.render()
+        assert plan.projected_states == {consts.UPGRADE_STATE_DONE: 4}
+        assert not any("control-plane" in t.node for t in plan.transitions)
+
+    def test_explicit_policy_not_found_is_fatal(self, tmp_path, capsys):
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+
+        cluster, _ = _fleet(n_slices=2)
+        path = tmp_path / "cluster.json"
+        path.write_text(json.dumps(cluster.to_dict()))
+        rc = cli_main(
+            [
+                "plan",
+                "--state-file",
+                str(path),
+                "--policy",
+                "typo-name",
+            ]
+        )
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "could not be loaded" in err
+
+    def test_inconsistent_snapshot_exits_2_not_traceback(
+        self, tmp_path, capsys
+    ):
+        """An unscheduled-driver-pod snapshot makes build_state raise
+        UpgradeStateError; the CLI must exit 2 with a message."""
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+
+        cluster, fleet = _fleet(n_slices=2)
+        ds = cluster.get("DaemonSet", "tpu-runtime", NAMESPACE)
+        ds["status"]["desiredNumberScheduled"] = 99
+        cluster.update(ds)
+        path = tmp_path / "cluster.json"
+        path.write_text(json.dumps(cluster.to_dict()))
+        rc = cli_main(["plan", "--state-file", str(path)])
+        assert rc == 2
+        assert "cannot plan" in capsys.readouterr().err
+
+    def test_sandbox_threads_are_released(self):
+        import threading
+
+        def upgrade_workers() -> int:
+            return sum(
+                1
+                for t in threading.enumerate()
+                if t.name.startswith(("upgrade-worker", "pod-check"))
+            )
+
+        cluster, _ = _fleet(n_slices=2)
+        baseline = upgrade_workers()
+        for _ in range(3):
+            plan_rollout(
+                cluster.to_dict(),
+                NAMESPACE,
+                dict(DRIVER_LABELS),
+                _policy(
+                    max_parallel_upgrades=0,
+                    max_unavailable=IntOrString("100%"),
+                    slice_aware=True,
+                ),
+            )
+        assert upgrade_workers() <= baseline
