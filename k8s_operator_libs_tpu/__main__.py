@@ -222,9 +222,26 @@ def cmd_plan(args: argparse.Namespace) -> int:
             dump = cluster.to_dict()
         else:
             # Live source: one read-only snapshot; the simulation runs
-            # entirely on the clone and never writes back.
+            # entirely on the clone and never writes back.  The sandbox
+            # RV counter must start ABOVE every restored object's RV, or
+            # sandbox writes would mint resourceVersions that collide
+            # with restored ones and defeat conflict detection.
             snap = cluster.snapshot()
-            dump = {"rv": 0, "objects": list(snap.values())}
+
+            def _rv(obj) -> int:
+                try:
+                    return int(
+                        (obj.get("metadata") or {}).get("resourceVersion")
+                        or 0
+                    )
+                except ValueError:
+                    return 0
+
+            objects = list(snap.values())
+            dump = {
+                "rv": max([0] + [_rv(o) for o in objects]),
+                "objects": objects,
+            }
         plan = plan_rollout(
             dump,
             args.namespace,

@@ -183,12 +183,21 @@ class _SimDaemonSetController:
         namespace: str,
         driver_labels: Dict[str, str],
         hash_resolver=None,
+        extra_nodes=(),
     ) -> None:
         """*hash_resolver*: ``(ds) -> newest revision hash`` — the plan
         passes the REAL PodManager oracle
         (:meth:`~.pod_manager.PodManager.get_daemonset_controller_revision_hash`)
         so the sandbox recreates pods at exactly the revision the real
-        operator would target (owner-less backup revisions included)."""
+        operator would target (owner-less backup revisions included).
+
+        *extra_nodes*: managed nodes with NO pod in the snapshot (taken
+        mid-restart-wave, after the delete and before the recreate) —
+        still the DaemonSet's responsibility.  Unambiguous only with one
+        DaemonSet; with several there is no signal which one owned the
+        vanished pod, so they are skipped (and the snapshot's desired-
+        count mismatch will surface as an UpgradeStateError instead of a
+        silent wrong plan)."""
         self._sim = sim
         self._namespace = namespace
         self._labels = dict(driver_labels)
@@ -208,6 +217,18 @@ class _SimDaemonSetController:
             if ds_name is not None:
                 node = (pod.get("spec") or {}).get("nodeName") or ""
                 self._covered.setdefault(ds_name, set()).add(node)
+        if extra_nodes and len(self._ds_by_name) == 1:
+            # Cap by the snapshot's own accounting: only as many heals as
+            # the DS reports missing (desired - scheduled).  A labeled
+            # node the DS no longer targets (desired already met) must
+            # not get a phantom pod.
+            only_ds = next(iter(self._covered))
+            ds = self._ds_by_name[only_ds]
+            desired = int(
+                (ds.get("status") or {}).get("desiredNumberScheduled", 0)
+            )
+            missing = max(0, desired - len(self._covered[only_ds]))
+            self._covered[only_ds].update(sorted(extra_nodes)[:missing])
 
     def _owner_ds(self, pod: dict) -> Optional[str]:
         for ref in (pod.get("metadata") or {}).get("ownerReferences") or []:
@@ -298,6 +319,21 @@ def plan_rollout(
     )
     horizon = cycles if cycles > 0 else MAX_CYCLES
     horizon = min(horizon, MAX_CYCLES)
+
+    # The rollout only ever labels nodes hosting driver pods; clusters
+    # have other nodes too (control plane, CPU pools).  Convergence and
+    # the transition diff are scoped to MANAGED nodes — driver-pod hosts
+    # plus any node already carrying a state label (mid-rollout hosts
+    # whose pod is momentarily gone) — or a bystander node would keep a
+    # completed rollout reading "blocked" forever.
+    selector = ",".join(f"{k}={v}" for k, v in sorted(driver_labels.items()))
+    pod_hosts = {
+        (p.get("spec") or {}).get("nodeName") or ""
+        for p in sim.list("Pod", namespace, selector)
+    } - {""}
+    labeled = {n for n, s in _node_states(sim).items() if s}
+    managed = pod_hosts | labeled
+
     ds_controller = (
         _SimDaemonSetController(
             sim,
@@ -308,23 +344,18 @@ def plan_rollout(
             # and all — code-review finding: a reimplementation here
             # would let the plan drift from apply_state)
             hash_resolver=manager.pod_manager.get_daemonset_controller_revision_hash,
+            # labeled pod-less nodes: snapshot taken mid-restart-wave
+            extra_nodes=labeled - pod_hosts,
         )
         if play_daemonset
         else None
     )
-
-    # The rollout only ever labels nodes hosting driver pods; clusters
-    # have other nodes too (control plane, CPU pools).  Convergence and
-    # the transition diff are scoped to MANAGED nodes — driver-pod hosts
-    # plus any node already carrying a state label (mid-rollout hosts
-    # whose pod is momentarily gone) — or a bystander node would keep a
-    # completed rollout reading "blocked" forever.
-    selector = ",".join(f"{k}={v}" for k, v in sorted(driver_labels.items()))
-    managed = {
-        (p.get("spec") or {}).get("nodeName") or ""
-        for p in sim.list("Pod", namespace, selector)
-    } - {""}
-    managed |= {n for n, s in _node_states(sim).items() if s}
+    if ds_controller is not None:
+        # Pre-heal BEFORE the first build_state: a mid-wave snapshot has
+        # desired > scheduled, which build_state (correctly) rejects; on
+        # a live cluster the DS controller closes that gap continuously,
+        # so the sandbox plays one catch-up round first.
+        ds_controller.reconcile()
 
     def managed_states() -> Dict[str, str]:
         return {

@@ -104,6 +104,7 @@ class ClusterUpgradeStateManager:
                 thread_name_prefix="upgrade-worker",
             )
         self._owned_pool = shared_pool
+        self._owned_managers: list = []
         self._drain_manager = drain_manager or DrainManager(
             cluster,
             self._provider,
@@ -111,9 +112,13 @@ class ClusterUpgradeStateManager:
             pre_drain_gate=pre_drain_gate,
             pool=shared_pool,
         )
+        if drain_manager is None:
+            self._owned_managers.append(self._drain_manager)
         self._pod_manager = pod_manager or PodManager(
             cluster, self._provider, recorder, pool=shared_pool
         )
+        if pod_manager is None:
+            self._owned_managers.append(self._pod_manager)
         self._validation_manager = validation_manager or ValidationManager(
             cluster, self._provider, recorder
         )
@@ -143,7 +148,7 @@ class ClusterUpgradeStateManager:
         manager per request — call it to avoid accumulating idle
         threads.  Injected managers/pools belong to their creators and
         are left alone."""
-        for mgr in (self._drain_manager, self._pod_manager):
+        for mgr in self._owned_managers:
             fn = getattr(mgr, "shutdown", None)
             if callable(fn):
                 fn(wait)

@@ -789,3 +789,163 @@ class TestSliceCoherentChaos:
                 break
         else:
             pytest.fail(f"did not converge: {fleet.states()}")
+
+
+# ---------------------------------------------------------------------------
+# Policy mutations mid-rollout: live CR edits (the CrPolicySource path)
+# arrive at arbitrary points.  The chaos above keeps ONE policy per
+# scenario; real fleets shrink budgets, pause, and resume while nodes are
+# mid-flight.  Property: the active set never GROWS past the policy in
+# force at that moment — in-flight work finishes (a shrunk budget cannot
+# retract an admitted slice) but nothing NEW is admitted beyond it, a
+# paused rollout admits nothing, and the final (permissive) policy always
+# converges the fleet.
+# ---------------------------------------------------------------------------
+
+
+def _active_units(cluster, slice_aware: bool) -> int:
+    state_key = util.get_upgrade_state_label_key()
+    nodes = cluster.list("Node")
+    active = [
+        n
+        for n in nodes
+        if (n["metadata"].get("labels") or {}).get(state_key, "")
+        not in IDLE_STATES
+    ]
+    if slice_aware:
+        return len({topology.domain_of(n) for n in active})
+    return len(active)
+
+
+def _unit_budget(cluster, policy: UpgradePolicySpec) -> float:
+    """The number of units the policy in force allows to be active."""
+    if not policy.auto_upgrade:
+        return 0.0
+    nodes = cluster.list("Node")
+    total = topology.count_domains(nodes) if policy.slice_aware else len(nodes)
+    budget = float(policy.max_unavailable.scaled_value(total, round_up=True))
+    if policy.max_parallel_upgrades > 0:
+        budget = min(budget, float(policy.max_parallel_upgrades))
+    return budget
+
+
+class TestPolicyMutationChaos:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_policy_edits_mid_rollout_hold_going_forward(self, seed):
+        rng = random.Random(9000 + seed)
+        cluster = InMemoryCluster()
+        fleet = build_random_fleet(rng, cluster)
+        # Unit semantics fixed per scenario: flipping slice_aware
+        # mid-rollout redefines what a "unit" is and the non-growth
+        # property would compare apples to slices.
+        slice_aware = rng.random() < 0.5
+
+        def fresh_policy(auto: bool = True) -> UpgradePolicySpec:
+            return UpgradePolicySpec(
+                auto_upgrade=auto,
+                max_parallel_upgrades=rng.choice([0, 1, 2]),
+                max_unavailable=IntOrString(rng.choice([1, 2, "25%", "50%"])),
+                slice_aware=slice_aware,
+                drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+            )
+
+        policy = fresh_policy()
+        manager = make_manager(cluster)
+        prev_active = 0
+        mutations = 0
+        for cycle in range(120):
+            # after cycle 60 stop mutating and force a permissive policy
+            # so convergence is always reachable
+            if cycle == 60:
+                policy = UpgradePolicySpec(
+                    auto_upgrade=True,
+                    max_parallel_upgrades=0,
+                    max_unavailable=IntOrString("50%"),
+                    slice_aware=slice_aware,
+                    drain_spec=DrainSpec(
+                        enable=True, force=True, timeout_second=10
+                    ),
+                )
+            elif cycle and rng.random() < 0.2:
+                policy = fresh_policy(auto=rng.random() > 0.25)
+                mutations += 1
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10.0)
+            manager.pod_manager.wait_idle(10.0)
+            fleet.reconcile_daemonset()
+            active = _active_units(cluster, slice_aware)
+            allowed = max(float(prev_active), _unit_budget(cluster, policy))
+            assert active <= allowed, (
+                f"seed {seed} cycle {cycle}: active units grew to {active} "
+                f"past {allowed} (policy maxParallel="
+                f"{policy.max_parallel_upgrades} maxUnavailable="
+                f"{policy.max_unavailable} auto={policy.auto_upgrade})"
+            )
+            prev_active = active
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        else:
+            pytest.fail(
+                f"seed {seed}: did not converge after {mutations} mutations: "
+                f"{fleet.states()}"
+            )
+        # live edits never push a node across an undefined edge either
+        illegal = [
+            t
+            for t in observed_transitions(cluster)
+            if t not in LEGAL_TRANSITIONS
+        ]
+        assert illegal == [], f"seed {seed}: illegal transitions {illegal}"
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_pause_resume_freezes_then_finishes(self, seed):
+        """auto_upgrade=False mid-rollout: in-flight nodes may finish but
+        the upgrade-required backlog must not shrink while paused; resume
+        drains the backlog to done."""
+        rng = random.Random(9100 + seed)
+        cluster = InMemoryCluster()
+        fleet = build_random_fleet(rng, cluster)
+        running = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        paused = UpgradePolicySpec(auto_upgrade=False)
+        manager = make_manager(cluster)
+        state_key = util.get_upgrade_state_label_key()
+
+        def required() -> int:
+            return sum(
+                1
+                for n in cluster.list("Node")
+                if (n["metadata"].get("labels") or {}).get(state_key, "")
+                == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+            )
+
+        # run a few cycles, then pause
+        for _ in range(rng.randint(2, 5)):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, running)
+            manager.drain_manager.wait_idle(10.0)
+            manager.pod_manager.wait_idle(10.0)
+            fleet.reconcile_daemonset()
+        backlog = required()
+        for _ in range(6):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, paused)
+            manager.drain_manager.wait_idle(10.0)
+            manager.pod_manager.wait_idle(10.0)
+            fleet.reconcile_daemonset()
+            assert required() >= backlog, "paused rollout admitted a node"
+        # resume and converge
+        for _ in range(80):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, running)
+            manager.drain_manager.wait_idle(10.0)
+            manager.pod_manager.wait_idle(10.0)
+            fleet.reconcile_daemonset()
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                return
+        pytest.fail(f"seed {seed}: did not converge after resume")
