@@ -242,12 +242,29 @@ def cmd_plan(args: argparse.Namespace) -> int:
                 "rv": max([0] + [_rv(o) for o in objects]),
                 "objects": objects,
             }
+        requestor_opts = None
+        if args.requestor:
+            # Same env contract as the operator (incl. the CR name
+            # prefix — an in-flight 'myprefix-<node>' CR must be FOUND,
+            # not duplicated), with CLI flags overlaid.
+            from .upgrade.upgrade_requestor import get_requestor_opts_from_envs
+
+            requestor_opts = get_requestor_opts_from_envs()
+            requestor_opts.use_maintenance_operator = True
+            if args.requestor_id:
+                requestor_opts.requestor_id = args.requestor_id
+            if not requestor_opts.requestor_id:
+                requestor_opts.requestor_id = "plan-preview"
+            if args.requestor_namespace:
+                requestor_opts.requestor_namespace = args.requestor_namespace
         plan = plan_rollout(
             dump,
             args.namespace,
             _parse_selector_arg(args.selector),
             policy,
             cycles=args.cycles,
+            requestor_opts=requestor_opts,
+            validation_pod_selector=args.validation_selector,
         )
     except (ApiError, OSError, UpgradeStateError) as err:
         print(f"cannot plan from cluster state: {err}", file=sys.stderr)
@@ -328,6 +345,30 @@ def main(argv=None) -> int:
         default=0,
         help="simulation horizon in reconcile cycles (0 = until "
         "convergence or steady state, capped)",
+    )
+    pl.add_argument(
+        "--requestor",
+        action="store_true",
+        help="plan the requestor-mode handoff (NodeMaintenance CRs; a "
+        "simulated maintenance operator grants Ready optimistically)",
+    )
+    pl.add_argument(
+        "--requestor-id",
+        default="",
+        help="requestor identity for --requestor (default: "
+        "$MAINTENANCE_OPERATOR_REQUESTOR_ID, else 'plan-preview')",
+    )
+    pl.add_argument(
+        "--requestor-namespace",
+        default="",
+        help="NodeMaintenance namespace for --requestor (default: "
+        "$MAINTENANCE_OPERATOR_REQUESTOR_NAMESPACE, else 'default')",
+    )
+    pl.add_argument(
+        "--validation-selector",
+        default="",
+        help="enable the validation state with this pod label selector "
+        "(validation pods are synthesized Ready — optimistic)",
     )
     pl.set_defaults(func=cmd_plan)
 

@@ -95,6 +95,51 @@ def matches(selector: str, labels: Mapping[str, str] | None) -> bool:
     return parse_selector(selector)(labels or {})
 
 
+def example_labels(selector: str) -> "Dict[str, str] | None":
+    """A minimal label set satisfying *selector*, or None when no such
+    set can be synthesized (conflicting or unparsable requirements).
+    Used by simulations that must CREATE objects a selector will match
+    — e.g. the plan sandbox synthesizing validation pods — so the one
+    selector grammar serves both matching and generation."""
+    selector = (selector or "").strip()
+    labels: Dict[str, str] = {}
+    if selector:
+        try:
+            for req in _split_requirements(selector):
+                m = _IN_RE.match(req)
+                if m:
+                    key, op, vals = m.group(1), m.group(2), m.group(3)
+                    values = [v.strip() for v in vals.split(",") if v.strip()]
+                    if op == "in":
+                        if not values:
+                            return None
+                        labels[key] = values[0]
+                    else:  # notin: key present with an outside value
+                        candidate = "synthesized"
+                        while candidate in values:
+                            candidate += "-x"
+                        labels.setdefault(key, candidate)
+                    continue
+                m = _EQ_RE.match(req)
+                if m:
+                    key, op, val = m.group(1), m.group(2), m.group(3)
+                    if op in ("=", "=="):
+                        labels[key] = val
+                    # "!=" is satisfied by absence; add nothing
+                    continue
+                m = _EXISTS_RE.match(req)
+                if m:
+                    if not m.group(1):
+                        labels.setdefault(m.group(2), "synthesized")
+                    # "!a" is satisfied by absence
+                    continue
+                return None
+        except SelectorParseError:
+            return None
+    # conflicting conjunctions (a=b,a=c / a=b,!a) fail this final check
+    return labels if parse_selector(selector)(labels) else None
+
+
 def labels_to_selector(labels: Dict[str, str]) -> str:
     """Reference: labels.SelectorFromSet — exact-match conjunction."""
     return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))

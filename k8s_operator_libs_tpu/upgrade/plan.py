@@ -16,10 +16,13 @@ SURVEY.md §4).  What the plan predicts is what ``apply_state`` does,
 because it IS ``apply_state`` — on a sandbox.
 
 The projection is the *optimistic trajectory*: drains succeed within
-their grace, driver pods come back Ready at the new revision, validation
-is not simulated (plan the manager without it, as the default operator
-assembly does).  Schedule gates (maintenance windows, hourly pacing)
-are evaluated against the wall clock at planning time.
+their grace, driver pods come back Ready at the new revision, the
+external maintenance operator (requestor mode) grants Ready, and
+validation pods come up Ready.  Mirror the operator's own assembly for
+full fidelity — pass ``requestor_opts`` / ``pod_deletion_filter`` /
+``validation_pod_selector`` to :func:`plan_rollout` exactly as the
+consumer configures its manager.  Schedule gates (maintenance windows,
+hourly pacing) are evaluated against the wall clock at planning time.
 
 Entry points: :func:`plan_rollout` (library) and
 ``python -m k8s_operator_libs_tpu plan`` (CLI; offline from a
@@ -31,8 +34,9 @@ from __future__ import annotations
 
 import itertools
 import logging
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..api.upgrade_spec import UpgradePolicySpec
 from ..cluster.inmem import InMemoryCluster
@@ -88,18 +92,23 @@ class RolloutPlan:
     # ------------------------------------------------------------ queries
     @property
     def next_admissions(self) -> List[str]:
-        """Nodes admitted at the plan's FIRST admitting cycle
-        (upgrade-required -> cordon-required) — the next blast-radius
-        increment.  A fresh fleet spends cycle 1 classifying nodes into
-        upgrade-required, so the first admissions appear in cycle 2;
-        mid-rollout snapshots usually admit in cycle 1."""
+        """Nodes admitted at the plan's FIRST admitting cycle — the next
+        blast-radius increment.  An admission is upgrade-required ->
+        cordon-required (in-place) or -> node-maintenance-required
+        (requestor handoff).  A fresh fleet spends cycle 1 classifying
+        nodes into upgrade-required, so the first admissions appear in
+        cycle 2; mid-rollout snapshots usually admit in cycle 1."""
+        admitted_to = (
+            consts.UPGRADE_STATE_CORDON_REQUIRED,
+            consts.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED,
+        )
         for cycle in range(1, self.cycles_simulated + 1):
             batch = [
                 t.node
                 for t in self.transitions
                 if t.cycle == cycle
                 and t.from_state == consts.UPGRADE_STATE_UPGRADE_REQUIRED
-                and t.to_state == consts.UPGRADE_STATE_CORDON_REQUIRED
+                and t.to_state in admitted_to
             ]
             if batch:
                 return batch
@@ -295,6 +304,139 @@ class _SimDaemonSetController:
         return created
 
 
+class SimMaintenanceOperator:
+    """A stand-in external maintenance operator: picks up NodeMaintenance
+    CRs, cordons + drains the named node out-of-band, then reports the
+    Ready condition — the counterpart the requestor mode hands off to
+    (reference: Mellanox maintenance-operator; conditions consumed at
+    upgrade_requestor.go:416-452).  Used by the plan sandbox to project
+    requestor-mode rollouts and by the test suites as the fake external
+    operator (one implementation, so tests and plans agree on the
+    contract)."""
+
+    def __init__(
+        self,
+        cluster,
+        namespace: str = "default",
+        ready_delay_seconds: float = 0.0,
+    ) -> None:
+        self.cluster = cluster
+        self.namespace = namespace
+        #: Minimum CR age before Ready is reported — real maintenance
+        #: (cordon + drain) takes time; a nonzero delay keeps CRs open
+        #: long enough for shared-requestor appends to overlap.
+        self.ready_delay_seconds = ready_delay_seconds
+        self._first_seen: Dict[str, float] = {}
+
+    FINALIZER = "maintenance.tpu.google.com/finalizer"
+
+    def reconcile(self) -> int:
+        from ..cluster.errors import NotFoundError
+
+        handled = 0
+        crs = self.cluster.list("NodeMaintenance", namespace=self.namespace)
+        # Prune first-seen stamps of vanished CRs: a deleted-and-recreated
+        # same-name CR must serve a fresh ready_delay window.
+        live = {nm["metadata"]["name"] for nm in crs}
+        for name in [n for n in self._first_seen if n not in live]:
+            del self._first_seen[name]
+        for nm in crs:
+            # Graceful-deletion arbitration: the requestor's delete is only a
+            # *request* (upgrade_requestor.go:241-246 "assuming maintenance OP
+            # will handle actual obj deletion"); the CR is released once no
+            # additional requestors remain.
+            if nm["metadata"].get("deletionTimestamp"):
+                if not (nm.get("spec") or {}).get("additionalRequestors"):
+                    nm["metadata"]["finalizers"] = []
+                    self.cluster.update(nm)
+                continue
+            conds = (nm.get("status") or {}).get("conditions") or []
+            if any(c.get("type") == "Ready" for c in conds):
+                continue
+            if self.ready_delay_seconds > 0:
+                first = self._first_seen.setdefault(
+                    nm["metadata"]["name"], time.monotonic()
+                )
+                if time.monotonic() - first < self.ready_delay_seconds:
+                    continue  # maintenance still "in progress"
+            if self.FINALIZER not in (nm["metadata"].get("finalizers") or []):
+                nm["metadata"].setdefault("finalizers", []).append(self.FINALIZER)
+            node_name = (nm.get("spec") or {}).get("nodeName", "")
+            try:
+                self.cluster.patch(
+                    "Node", node_name, {"spec": {"unschedulable": True}}
+                )
+            except NotFoundError:
+                # node gone: still take ownership (finalizer) but no work
+                self.cluster.update(nm)
+                continue
+            # evict non-driver pods (crude out-of-band drain)
+            for pod in self.cluster.list("Pod"):
+                owners = (pod.get("metadata") or {}).get("ownerReferences") or []
+                is_ds = any(o.get("kind") == "DaemonSet" for o in owners)
+                if (pod.get("spec") or {}).get("nodeName") == node_name and not is_ds:
+                    self.cluster.delete(
+                        "Pod",
+                        pod["metadata"]["name"],
+                        pod["metadata"].get("namespace", ""),
+                    )
+            nm.setdefault("status", {}).setdefault("conditions", []).append(
+                {"type": "Ready", "status": "True", "reason": "Ready"}
+            )
+            self.cluster.update(nm)
+            handled += 1
+        return handled
+
+
+class _SimValidationController:
+    """Optimistic validation play: for every node waiting in
+    validation-required, ensure a Running+Ready pod matching the
+    validation selector exists on it (the consumer's validation
+    DaemonSet's role).  Label synthesis uses the one selector grammar
+    (:func:`~..cluster.selectors.example_labels`); a selector no label
+    set can satisfy leaves validation unsimulated — the plan then shows
+    it timing out, which is itself informative."""
+
+    def __init__(self, sim: InMemoryCluster, pod_selector: str) -> None:
+        from ..cluster.selectors import example_labels
+
+        self._sim = sim
+        self._selector = pod_selector
+        self._labels = example_labels(pod_selector)
+        self._seq = itertools.count()
+
+    def reconcile(self) -> int:
+        if self._labels is None:
+            return 0
+        key = util.get_upgrade_state_label_key()
+        created = 0
+        for node in self._sim.list("Node"):
+            state = (node["metadata"].get("labels") or {}).get(key, "")
+            if state != consts.UPGRADE_STATE_VALIDATION_REQUIRED:
+                continue
+            name = name_of(node)
+            # the membership check uses the ORIGINAL selector, exactly as
+            # ValidationManager.validate lists (validation_manager.py)
+            have = self._sim.list(
+                "Pod",
+                label_selector=self._selector,
+                field_selector=f"spec.nodeName={name}",
+            )
+            if have:
+                continue
+            self._sim.create(
+                make_pod(
+                    f"validation-plan-{next(self._seq)}",
+                    "kube-system",
+                    name,
+                    labels=dict(self._labels),
+                    ready=True,
+                )
+            )
+            created += 1
+        return created
+
+
 def plan_rollout(
     source_dump: dict,
     namespace: str,
@@ -303,6 +445,9 @@ def plan_rollout(
     *,
     cycles: int = 0,
     play_daemonset: bool = True,
+    requestor_opts=None,
+    pod_deletion_filter: Optional[Callable] = None,
+    validation_pod_selector: str = "",
 ) -> RolloutPlan:
     """Simulate the rollout on a sandbox clone and return the projected
     trajectory.
@@ -310,13 +455,38 @@ def plan_rollout(
     *source_dump* is an :meth:`InMemoryCluster.to_dict` dump (the CLI
     builds one from a state file or a live cluster read).  *cycles* is
     the horizon: 0 = run until convergence or steady state (capped at
-    :data:`MAX_CYCLES`).  The source is never mutated."""
+    :data:`MAX_CYCLES`).  The source is never mutated.
+
+    Mirror the operator's assembly for full fidelity: *requestor_opts*
+    (a :class:`~.upgrade_requestor.RequestorOptions`) plans the
+    requestor-mode handoff with a simulated maintenance operator
+    granting Ready optimistically; *pod_deletion_filter* /
+    *validation_pod_selector* enable the optional builder states the
+    consumer enables (validation pods are synthesized Ready on each
+    validating node — the optimistic trajectory)."""
     sim = InMemoryCluster.from_dict(source_dump, termination_grace_scale=0.0)
     manager = ClusterUpgradeStateManager(
         sim,
         cache_sync_timeout_seconds=5.0,
         cache_sync_poll_seconds=0.005,
     )
+    mop = None
+    if requestor_opts is not None:
+        from .upgrade_requestor import RequestorNodeStateManager
+
+        manager.with_requestor(
+            RequestorNodeStateManager(manager.common, requestor_opts),
+            enabled=True,
+        )
+        mop = SimMaintenanceOperator(
+            sim, namespace=requestor_opts.requestor_namespace
+        )
+    if pod_deletion_filter is not None:
+        manager.with_pod_deletion_enabled(pod_deletion_filter)
+    validation = None
+    if validation_pod_selector:
+        manager.with_validation_enabled(validation_pod_selector)
+        validation = _SimValidationController(sim, validation_pod_selector)
     horizon = cycles if cycles > 0 else MAX_CYCLES
     horizon = min(horizon, MAX_CYCLES)
 
@@ -392,9 +562,15 @@ def plan_rollout(
             manager.apply_state(state, policy)
             manager.drain_manager.wait_idle(30.0)
             manager.pod_manager.wait_idle(30.0)
-            pods_created = (
+            progress = (
                 ds_controller.reconcile() if ds_controller is not None else 0
             )
+            if mop is not None:
+                # the external maintenance operator grants Ready (and
+                # completes CR deletions) — progress, like pod recreation
+                progress += mop.reconcile()
+            if validation is not None:
+                progress += validation.reconcile()
             after = managed_states()
             cycle_moves = [
                 PlannedTransition(node, before.get(node, ""), after[node], cycle)
@@ -409,7 +585,7 @@ def plan_rollout(
             # Steady state needs TWO consecutive cycles with neither node
             # transitions nor pod recreations: progress can be pod-level
             # only (a restart wave lands one cycle before its nodes move).
-            if not cycle_moves and pods_created == 0:
+            if not cycle_moves and progress == 0:
                 quiet_cycles += 1
                 if quiet_cycles >= 2:
                     steady = True

@@ -147,86 +147,12 @@ class Fleet:
         }
 
 
-class FakeMaintenanceOperator:
-    """A stand-in external maintenance operator: picks up NodeMaintenance
-    CRs, cordons + drains the named node out-of-band, then reports the
-    Ready condition — the counterpart the requestor mode hands off to
-    (reference: Mellanox maintenance-operator; conditions consumed at
-    upgrade_requestor.go:416-452)."""
-
-    def __init__(
-        self,
-        cluster: InMemoryCluster,
-        namespace: str = "default",
-        ready_delay_seconds: float = 0.0,
-    ) -> None:
-        self.cluster = cluster
-        self.namespace = namespace
-        #: Minimum CR age before Ready is reported — real maintenance
-        #: (cordon + drain) takes time; a nonzero delay keeps CRs open
-        #: long enough for shared-requestor appends to overlap.
-        self.ready_delay_seconds = ready_delay_seconds
-        self._first_seen: Dict[str, float] = {}
-
-    FINALIZER = "maintenance.tpu.google.com/finalizer"
-
-    def reconcile(self) -> int:
-        from k8s_operator_libs_tpu.cluster.errors import NotFoundError
-
-        handled = 0
-        crs = self.cluster.list("NodeMaintenance", namespace=self.namespace)
-        # Prune first-seen stamps of vanished CRs: a deleted-and-recreated
-        # same-name CR must serve a fresh ready_delay window.
-        live = {nm["metadata"]["name"] for nm in crs}
-        for name in [n for n in self._first_seen if n not in live]:
-            del self._first_seen[name]
-        for nm in crs:
-            # Graceful-deletion arbitration: the requestor's delete is only a
-            # *request* (upgrade_requestor.go:241-246 "assuming maintenance OP
-            # will handle actual obj deletion"); the CR is released once no
-            # additional requestors remain.
-            if nm["metadata"].get("deletionTimestamp"):
-                if not (nm.get("spec") or {}).get("additionalRequestors"):
-                    nm["metadata"]["finalizers"] = []
-                    self.cluster.update(nm)
-                continue
-            conds = (nm.get("status") or {}).get("conditions") or []
-            if any(c.get("type") == "Ready" for c in conds):
-                continue
-            if self.ready_delay_seconds > 0:
-                first = self._first_seen.setdefault(
-                    nm["metadata"]["name"], time.monotonic()
-                )
-                if time.monotonic() - first < self.ready_delay_seconds:
-                    continue  # maintenance still "in progress"
-            if self.FINALIZER not in (nm["metadata"].get("finalizers") or []):
-                nm["metadata"].setdefault("finalizers", []).append(self.FINALIZER)
-            node_name = (nm.get("spec") or {}).get("nodeName", "")
-            try:
-                self.cluster.patch(
-                    "Node", node_name, {"spec": {"unschedulable": True}}
-                )
-            except NotFoundError:
-                # node gone: still take ownership (finalizer) but no work
-                self.cluster.update(nm)
-                continue
-            # evict non-driver pods (crude out-of-band drain)
-            for pod in self.cluster.list("Pod"):
-                owners = (pod.get("metadata") or {}).get("ownerReferences") or []
-                is_ds = any(o.get("kind") == "DaemonSet" for o in owners)
-                if (pod.get("spec") or {}).get("nodeName") == node_name and not is_ds:
-                    self.cluster.delete(
-                        "Pod",
-                        pod["metadata"]["name"],
-                        pod["metadata"].get("namespace", ""),
-                    )
-            nm.setdefault("status", {}).setdefault("conditions", []).append(
-                {"type": "Ready", "status": "True", "reason": "Ready"}
-            )
-            self.cluster.update(nm)
-            handled += 1
-        return handled
-
+#: One implementation shared with the plan sandbox (the library's
+#: SimMaintenanceOperator) so tests and dry-run projections agree on the
+#: external maintenance-operator contract.
+from k8s_operator_libs_tpu.upgrade.plan import (  # noqa: E402
+    SimMaintenanceOperator as FakeMaintenanceOperator,
+)
 
 
 @contextmanager

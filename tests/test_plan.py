@@ -570,3 +570,111 @@ class TestPlanReviewRegressions:
         # the projection runs a full rollout on the clone; RV collisions
         # would surface as missed conflicts / stuck transitions
         assert data["converged"] is True
+
+
+class TestPlanModes:
+    """Planning with the operator's optional assembly mirrored: requestor
+    mode (NodeMaintenance handoff) and the validation builder state."""
+
+    def test_requestor_mode_plans_through_handoff(self):
+        from k8s_operator_libs_tpu.upgrade.upgrade_requestor import (
+            RequestorOptions,
+        )
+
+        cluster, _ = _fleet(n_slices=2)
+        plan = plan_rollout(
+            cluster.to_dict(),
+            NAMESPACE,
+            dict(DRIVER_LABELS),
+            _policy(
+                max_parallel_upgrades=0,
+                max_unavailable=IntOrString("100%"),
+                slice_aware=True,
+            ),
+            requestor_opts=RequestorOptions(
+                use_maintenance_operator=True,
+                requestor_id="plan-preview",
+                requestor_namespace="default",
+            ),
+        )
+        assert plan.converged, plan.render()
+        # the projection rode the requestor path, not cordon-required
+        states_seen = {t.to_state for t in plan.transitions}
+        assert consts.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED in states_seen
+        assert consts.UPGRADE_STATE_CORDON_REQUIRED not in states_seen
+
+    def test_validation_state_planned_optimistically(self):
+        cluster, _ = _fleet(n_slices=2)
+        plan = plan_rollout(
+            cluster.to_dict(),
+            NAMESPACE,
+            dict(DRIVER_LABELS),
+            _policy(
+                max_parallel_upgrades=0,
+                max_unavailable=IntOrString("100%"),
+                slice_aware=True,
+            ),
+            validation_pod_selector="app=validator",
+        )
+        assert plan.converged, plan.render()
+        states_seen = {t.to_state for t in plan.transitions}
+        assert consts.UPGRADE_STATE_VALIDATION_REQUIRED in states_seen
+
+    def test_requestor_cli_flag(self, tmp_path, capsys):
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+
+        cluster, _ = _fleet(n_slices=2)
+        path = tmp_path / "cluster.json"
+        path.write_text(json.dumps(cluster.to_dict()))
+        rc = cli_main(
+            ["plan", "--state-file", str(path), "--requestor", "--json"]
+        )
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert any(
+            t["to"] == consts.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED
+            for t in data["transitions"]
+        )
+
+    def test_set_based_validation_selector_synthesized(self):
+        """The selector grammar serves generation too: '==', 'in (...)'
+        and exists terms must all synthesize matching validation pods
+        (review finding: a hand-rolled parser rejected 'a==b')."""
+        for selector in (
+            "app==validator",
+            "app in (validator, other)",
+            "app=validator,tier!=canary",
+            "has-validator",
+        ):
+            cluster, _ = _fleet(n_slices=2)
+            plan = plan_rollout(
+                cluster.to_dict(),
+                NAMESPACE,
+                dict(DRIVER_LABELS),
+                _policy(
+                    max_parallel_upgrades=0,
+                    max_unavailable=IntOrString("100%"),
+                    slice_aware=True,
+                ),
+                validation_pod_selector=selector,
+            )
+            assert plan.converged, f"{selector!r}: {plan.render()}"
+
+    def test_requestor_cli_honors_prefix_env(self, tmp_path, capsys, monkeypatch):
+        """--requestor builds its options through the operator's env
+        contract (review finding: the CR name prefix was dropped, so the
+        plan would miss in-flight CRs and project duplicates)."""
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+
+        monkeypatch.setenv(
+            "MAINTENANCE_OPERATOR_NODE_MAINTENANCE_PREFIX", "myprefix"
+        )
+        cluster, _ = _fleet(n_slices=1)
+        path = tmp_path / "cluster.json"
+        path.write_text(json.dumps(cluster.to_dict()))
+        rc = cli_main(
+            ["plan", "--state-file", str(path), "--requestor", "--json"]
+        )
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert data["converged"] is True
