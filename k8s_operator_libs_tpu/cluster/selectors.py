@@ -102,42 +102,78 @@ def example_labels(selector: str) -> "Dict[str, str] | None":
     — e.g. the plan sandbox synthesizing validation pods — so the one
     selector grammar serves both matching and generation."""
     selector = (selector or "").strip()
-    labels: Dict[str, str] = {}
+    # Two-phase: collect per-key constraints first, then solve — a greedy
+    # single pass mis-assigned 'a=c,a in (b,c)' (overwrote c with b) and
+    # 'a in (b,c),a notin (b)' (kept the excluded b).
+    equals: Dict[str, str] = {}
+    in_sets: Dict[str, List[str]] = {}
+    notin_sets: Dict[str, set] = {}
+    must_exist: List[str] = []
     if selector:
-        try:
-            for req in _split_requirements(selector):
-                m = _IN_RE.match(req)
-                if m:
-                    key, op, vals = m.group(1), m.group(2), m.group(3)
-                    values = [v.strip() for v in vals.split(",") if v.strip()]
-                    if op == "in":
-                        if not values:
+        for req in _split_requirements(selector):
+            m = _IN_RE.match(req)
+            if m:
+                key, op, vals = m.group(1), m.group(2), m.group(3)
+                values = [v.strip() for v in vals.split(",") if v.strip()]
+                if op == "in":
+                    if not values:
+                        return None
+                    in_sets.setdefault(key, [])
+                    # conjunction of in-sets: intersect
+                    if in_sets[key]:
+                        in_sets[key] = [
+                            v for v in in_sets[key] if v in values
+                        ]
+                        if not in_sets[key]:
                             return None
-                        labels[key] = values[0]
-                    else:  # notin: key present with an outside value
-                        candidate = "synthesized"
-                        while candidate in values:
-                            candidate += "-x"
-                        labels.setdefault(key, candidate)
-                    continue
-                m = _EQ_RE.match(req)
-                if m:
-                    key, op, val = m.group(1), m.group(2), m.group(3)
-                    if op in ("=", "=="):
-                        labels[key] = val
-                    # "!=" is satisfied by absence; add nothing
-                    continue
-                m = _EXISTS_RE.match(req)
-                if m:
-                    if not m.group(1):
-                        labels.setdefault(m.group(2), "synthesized")
-                    # "!a" is satisfied by absence
-                    continue
-                return None
-        except SelectorParseError:
+                    else:
+                        in_sets[key] = list(values)
+                else:
+                    notin_sets.setdefault(key, set()).update(values)
+                continue
+            m = _EQ_RE.match(req)
+            if m:
+                key, op, val = m.group(1), m.group(2), m.group(3)
+                if op in ("=", "=="):
+                    if key in equals and equals[key] != val:
+                        return None
+                    equals[key] = val
+                # "!=" is satisfied by absence; add nothing
+                continue
+            m = _EXISTS_RE.match(req)
+            if m:
+                if not m.group(1):
+                    must_exist.append(m.group(2))
+                # "!a" is satisfied by absence
+                continue
             return None
-    # conflicting conjunctions (a=b,a=c / a=b,!a) fail this final check
-    return labels if parse_selector(selector)(labels) else None
+    labels: Dict[str, str] = dict(equals)
+    for key, allowed in in_sets.items():
+        if key in labels:
+            if labels[key] not in allowed:
+                return None
+        else:
+            excluded = notin_sets.get(key, set())
+            pick = next((v for v in allowed if v not in excluded), None)
+            if pick is None:
+                return None
+            labels[key] = pick
+    for key, excluded in notin_sets.items():
+        if key in labels:
+            if labels[key] in excluded:
+                return None
+        else:
+            candidate = "synthesized"
+            while candidate in excluded:
+                candidate += "-x"
+            labels[key] = candidate
+    for key in must_exist:
+        labels.setdefault(key, "synthesized")
+    # residual conflicts (a=b,!a) fail this final check
+    try:
+        return labels if parse_selector(selector)(labels) else None
+    except SelectorParseError:
+        return None
 
 
 def labels_to_selector(labels: Dict[str, str]) -> str:

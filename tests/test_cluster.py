@@ -528,3 +528,49 @@ class TestIndexToggleEquivalence:
         assert [n["metadata"]["name"] for n in indexed.list("Node")] == [
             n["metadata"]["name"] for n in scanning.list("Node")
         ]
+
+
+class TestExampleLabels:
+    """selectors.example_labels: synthesize a label set a selector will
+    match (the plan sandbox's validation-pod generator)."""
+
+    def test_satisfiable_selectors_synthesize(self):
+        from k8s_operator_libs_tpu.cluster.selectors import (
+            example_labels,
+            matches,
+        )
+
+        cases = [
+            "app=validator",
+            "app==validator",
+            "app in (validator, other)",
+            "app=validator,tier!=canary",
+            "has-validator",
+            "a=c,a in (b,c)",          # greedy-pass regression
+            "a in (b,c),a notin (b)",  # greedy-pass regression
+            "a in (b,c),a in (c,d)",   # intersection
+            "x notin (p,q)",
+            "app=web,!legacy",
+        ]
+        for selector in cases:
+            labels = example_labels(selector)
+            assert labels is not None, selector
+            assert matches(selector, labels), (selector, labels)
+
+    def test_unsatisfiable_selectors_return_none(self):
+        from k8s_operator_libs_tpu.cluster.selectors import example_labels
+
+        for selector in (
+            "a=b,a=c",
+            "a=b,!a",
+            "a in (b),a in (c)",
+            "a=x,a in (b,c)",
+            "a in (b),a notin (b)",
+            "a in ()",
+        ):
+            assert example_labels(selector) is None, selector
+
+    def test_empty_selector_matches_everything(self):
+        from k8s_operator_libs_tpu.cluster.selectors import example_labels
+
+        assert example_labels("") == {}
