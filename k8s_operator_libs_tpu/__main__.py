@@ -276,7 +276,33 @@ def cmd_plan(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_history(args: argparse.Namespace) -> int:
+    cluster, rc = _open_source(args, "history")
+    if cluster is None:
+        return rc
+    from .cluster.errors import ApiError
+    from .upgrade.history import node_event_history, render_history
+
+    try:
+        entries = node_event_history(
+            cluster,
+            node=args.node or None,
+            namespaces=(
+                [args.events_namespace] if args.events_namespace else None
+            ),
+        )
+    except (ApiError, OSError) as err:
+        print(f"cannot read events: {err}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps([e.to_dict() for e in entries]))
+    else:
+        print(render_history(entries))
+    return 0
+
+
 def _add_source_args(sp: argparse.ArgumentParser) -> None:
+    """How to OPEN the cluster (shared by every read-only subcommand)."""
     sp.add_argument(
         "--state-file", default="", help="cluster dump JSON (offline mode)"
     )
@@ -290,6 +316,12 @@ def _add_source_args(sp: argparse.ArgumentParser) -> None:
     )
     sp.add_argument("--context", default=None)
     sp.add_argument("--in-cluster", action="store_true")
+    sp.add_argument("--json", action="store_true", help="machine output")
+
+
+def _add_query_args(sp: argparse.ArgumentParser) -> None:
+    """WHAT to query: the driver-fleet coordinates status/plan snapshot
+    on (history reads raw Events and takes none of these)."""
     sp.add_argument("--namespace", default="tpu-ops")
     sp.add_argument(
         "--selector",
@@ -301,7 +333,6 @@ def _add_source_args(sp: argparse.ArgumentParser) -> None:
         default="tpu-runtime",
         help="managed component name (parameterizes the label keys)",
     )
-    sp.add_argument("--json", action="store_true", help="machine output")
 
 
 def main(argv=None) -> int:
@@ -313,6 +344,7 @@ def main(argv=None) -> int:
 
     st = sub.add_parser("status", help="print rollout status")
     _add_source_args(st)
+    _add_query_args(st)
     st.add_argument(
         "--policy",
         default="",
@@ -333,6 +365,7 @@ def main(argv=None) -> int:
         "admissions/transitions and gates; never writes",
     )
     _add_source_args(pl)
+    _add_query_args(pl)
     pl.add_argument(
         "--policy",
         default="",
@@ -371,6 +404,21 @@ def main(argv=None) -> int:
         "(validation pods are synthesized Ready — optimistic)",
     )
     pl.set_defaults(func=cmd_plan)
+
+    hi = sub.add_parser(
+        "history",
+        help="per-node upgrade timeline from the cluster-visible Events "
+        "the operator writes (kubectl rollout history analog)",
+    )
+    _add_source_args(hi)
+    hi.add_argument("--node", default="", help="only this node's events")
+    hi.add_argument(
+        "--events-namespace",
+        default="",
+        help="namespace holding the Event objects (default: all "
+        "namespaces, like kubectl get events -A)",
+    )
+    hi.set_defaults(func=cmd_history)
 
     args = parser.parse_args(argv)
     try:

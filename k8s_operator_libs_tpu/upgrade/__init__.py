@@ -36,6 +36,7 @@ from .upgrade_requestor import (
     get_requestor_opts_from_envs,
     new_requestor_id_predicate,
 )
+from .history import HistoryEntry, node_event_history, render_history
 from .plan import PlannedTransition, RolloutPlan, plan_rollout
 from .rollout_status import DomainStatus, GateStatus, RolloutStatus
 from .upgrade_state import ClusterUpgradeStateManager, UpgradeStateError
@@ -82,4 +83,7 @@ __all__ = [
     "PlannedTransition",
     "RolloutPlan",
     "plan_rollout",
+    "HistoryEntry",
+    "node_event_history",
+    "render_history",
 ]

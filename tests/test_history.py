@@ -1,0 +1,218 @@
+"""Rollout history from cluster-visible Events (upgrade/history.py) —
+the `kubectl rollout history` analog over ClusterEventRecorder output."""
+
+from __future__ import annotations
+
+import json
+
+from k8s_operator_libs_tpu.api import DrainSpec, IntOrString, UpgradePolicySpec
+from k8s_operator_libs_tpu.cluster import InMemoryCluster
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    consts,
+    node_event_history,
+    render_history,
+    util,
+)
+
+from harness import DRIVER_LABELS, NAMESPACE, Fleet
+
+
+def _rolled_cluster():
+    """A fleet rolled to done through a recorder, leaving real Events."""
+    cluster = InMemoryCluster()
+    fleet = Fleet(cluster, revision_hash="v1")
+    for i in range(2):
+        fleet.add_node(f"n{i}")
+    fleet.publish_new_revision("v2")
+    recorder = util.ClusterEventRecorder(cluster, namespace=NAMESPACE)
+    manager = ClusterUpgradeStateManager(
+        cluster,
+        recorder=recorder,
+        cache_sync_timeout_seconds=2.0,
+        cache_sync_poll_seconds=0.01,
+    )
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("100%"),
+        drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+    )
+    for _ in range(40):
+        state = manager.build_state(NAMESPACE, dict(DRIVER_LABELS))
+        manager.apply_state(state, policy)
+        manager.drain_manager.wait_idle(10.0)
+        manager.pod_manager.wait_idle(10.0)
+        fleet.reconcile_daemonset()
+        if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+            break
+    else:
+        raise AssertionError(f"rollout did not converge: {fleet.states()}")
+    manager.shutdown()
+    return cluster
+
+
+class TestNodeEventHistory:
+    def test_full_rollout_leaves_a_timeline(self):
+        cluster = _rolled_cluster()
+        entries = node_event_history(cluster)
+        assert entries
+        nodes_seen = {e.node for e in entries}
+        # per-node milestones plus the aggregate-progress event (keyed by
+        # the component name)
+        assert {"n0", "n1"} <= nodes_seen
+        reasons = {e.reason for e in entries}
+        # at least admission and completion milestones appear
+        assert any("one" in r.lower() or "done" in r.lower() for r in reasons) or any(
+            consts.UPGRADE_STATE_DONE in e.message for e in entries
+        )
+        # ordered oldest -> newest by lastTimestamp
+        stamps = [e.last_timestamp for e in entries]
+        assert stamps == sorted(stamps)
+
+    def test_node_filter(self):
+        cluster = _rolled_cluster()
+        only = node_event_history(cluster, node="n1")
+        assert only and all(e.node == "n1" for e in only)
+
+    def test_namespace_scoping(self):
+        cluster = _rolled_cluster()
+        in_ns = node_event_history(cluster, namespaces=[NAMESPACE])
+        assert in_ns
+        empty = node_event_history(cluster, namespaces=["elsewhere"])
+        assert empty == []
+
+    def test_render_table(self):
+        cluster = _rolled_cluster()
+        text = render_history(node_event_history(cluster))
+        assert "LAST SEEN" in text and "REASON" in text
+        assert "n0" in text and "n1" in text
+        assert render_history([]) == "No node upgrade events found."
+
+
+class TestHistoryCli:
+    def test_history_from_state_file(self, tmp_path, capsys):
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+
+        cluster = _rolled_cluster()
+        path = tmp_path / "cluster.json"
+        path.write_text(json.dumps(cluster.to_dict()))
+        rc = cli_main(["history", "--state-file", str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "n0" in out and "LAST SEEN" in out
+
+        rc = cli_main(
+            ["history", "--state-file", str(path), "--node", "n1", "--json"]
+        )
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert data and all(e["node"] == "n1" for e in data)
+
+    def test_history_live_over_http(self, tmp_path, capsys):
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+        from k8s_operator_libs_tpu.cluster import ApiServerFacade
+
+        cluster = _rolled_cluster()
+        with ApiServerFacade(cluster) as facade:
+            kubeconfig = tmp_path / "kubeconfig"
+            kubeconfig.write_text(
+                "\n".join(
+                    [
+                        "apiVersion: v1",
+                        "kind: Config",
+                        "current-context: test",
+                        "contexts:",
+                        "- name: test",
+                        "  context: {cluster: test, user: test}",
+                        "clusters:",
+                        "- name: test",
+                        f"  cluster: {{server: {facade.url}}}",
+                        "users:",
+                        "- name: test",
+                        "  user: {token: dummy}",
+                    ]
+                )
+            )
+            rc = cli_main(
+                ["history", "--kubeconfig", str(kubeconfig), "--json"]
+            )
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert {"n0", "n1"} <= {e["node"] for e in data}
+
+
+class TestHistoryReviewRegressions:
+    def test_malformed_count_does_not_traceback(self, tmp_path, capsys):
+        """A hand-edited dump with a non-numeric Event count renders with
+        the default count instead of a ValueError traceback."""
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+
+        cluster = _rolled_cluster()
+        dump = cluster.to_dict()
+        for obj in dump["objects"]:
+            if obj.get("kind") == "Event":
+                obj["count"] = "2x"
+        path = tmp_path / "cluster.json"
+        path.write_text(json.dumps(dump))
+        rc = cli_main(["history", "--state-file", str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "n0" in out
+
+    def test_live_read_failure_exits_2_not_empty(self, capsys):
+        """An apiserver error on the Events read must exit 2, never print
+        'No node upgrade events found.' with rc=0 (review finding: the
+        explicit-namespace path swallowed every ApiError)."""
+        from k8s_operator_libs_tpu.cluster.errors import UnauthorizedError
+        from k8s_operator_libs_tpu.upgrade.history import node_event_history
+
+        class Denied:
+            def list(self, *a, **kw):
+                raise UnauthorizedError("token expired")
+
+        import pytest as _pytest
+
+        with _pytest.raises(UnauthorizedError):
+            node_event_history(Denied(), namespaces=["tpu-ops"])
+
+    def test_history_rejects_fleet_query_flags(self, tmp_path, capsys):
+        """history reads raw Events; the fleet-coordinate flags
+        (--component/--selector) belong to status/plan only and must be
+        rejected, not silently ignored (review finding)."""
+        import pytest as _pytest
+
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+
+        cluster = _rolled_cluster()
+        path = tmp_path / "cluster.json"
+        path.write_text(json.dumps(cluster.to_dict()))
+        with _pytest.raises(SystemExit):
+            cli_main(
+                [
+                    "history",
+                    "--state-file",
+                    str(path),
+                    "--component",
+                    "tpu-runtime",
+                ]
+            )
+
+    def test_server_side_field_selector_used_when_supported(self):
+        """Live path: Events are filtered server-side via the
+        involvedObject fieldSelector; unsupported backends fall back."""
+        from k8s_operator_libs_tpu.cluster.errors import BadRequestError
+        from k8s_operator_libs_tpu.upgrade.history import node_event_history
+
+        calls = []
+
+        class Recording:
+            def list(self, kind, namespace=None, field_selector="", **kw):
+                calls.append(field_selector)
+                if field_selector:
+                    raise BadRequestError("unsupported")
+                return []
+
+        node_event_history(Recording(), node="n1")
+        assert calls[0] == "involvedObject.kind=Node,involvedObject.name=n1"
+        assert calls[1] == ""  # fallback ran
