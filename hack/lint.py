@@ -262,6 +262,85 @@ class UndefinedNameChecker(ast.NodeVisitor):
         pass  # string annotations stay strings — never evaluated here
 
 
+def check_unused_imports(
+    path: pathlib.Path, tree: ast.Module, errors: list[str]
+) -> None:
+    """Module-level imports never referenced anywhere in the module.
+    ``__init__.py`` files are exempt (re-export tables), as are names in
+    ``__all__``, underscore-prefixed names, and ``__future__`` imports —
+    the golangci `unused` analog, scoped to the obvious wins."""
+    if path.name == "__init__.py":
+        return
+    exported: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == "__all__":
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        exported = {
+                            e.value
+                            for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        }
+    imported: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                imported[name] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                name = alias.asname or alias.name
+                imported[name] = node.lineno
+    if not imported:
+        return
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+    # Quoted forward references ('x: "Dict[str, int]"') use imports the
+    # Name walk cannot see — parse annotation strings and count their
+    # names as used (the UndefinedNameChecker exempts string annotations;
+    # this keeps the two checkers consistent instead of one punishing the
+    # pattern the other allows).
+    annotations: list[ast.expr] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AnnAssign) and node.annotation is not None:
+            annotations.append(node.annotation)
+        elif isinstance(node, ast.arg) and node.annotation is not None:
+            annotations.append(node.annotation)
+        elif (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.returns is not None
+        ):
+            annotations.append(node.returns)
+    for ann in annotations:
+        for sub in ast.walk(ann):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                try:
+                    parsed = ast.parse(sub.value, mode="eval")
+                except SyntaxError:
+                    continue
+                for name_node in ast.walk(parsed):
+                    if isinstance(name_node, ast.Name):
+                        used.add(name_node.id)
+    for name, lineno in sorted(imported.items(), key=lambda kv: kv[1]):
+        if name in used or name in exported or name.startswith("_"):
+            continue
+        errors.append(f"{path}:{lineno}: unused import {name!r}")
+
+
 def check_file(path: pathlib.Path, errors: list[str]) -> None:
     try:
         text = path.read_text(encoding="utf-8")
@@ -274,6 +353,7 @@ def check_file(path: pathlib.Path, errors: list[str]) -> None:
         errors.append(f"{path}: syntax error: {err}")
         return
     UndefinedNameChecker(path, errors).visit(tree)
+    check_unused_imports(path, tree, errors)
     for node in ast.walk(tree):
         if isinstance(node, ast.ImportFrom) and any(
             a.name == "*" for a in node.names

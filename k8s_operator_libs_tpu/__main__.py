@@ -290,6 +290,7 @@ def cmd_history(args: argparse.Namespace) -> int:
             namespaces=(
                 [args.events_namespace] if args.events_namespace else None
             ),
+            component=args.source or None,
         )
     except (ApiError, OSError) as err:
         print(f"cannot read events: {err}", file=sys.stderr)
@@ -417,6 +418,14 @@ def main(argv=None) -> int:
         default="",
         help="namespace holding the Event objects (default: all "
         "namespaces, like kubectl get events -A)",
+    )
+    hi.add_argument(
+        "--source",
+        default="",
+        help="only Events from this source.component — on a real "
+        "cluster Node events are mostly kubelet/node-controller noise; "
+        "pass the operator's recorder component (\"<name>Upgrade\") for "
+        "the pure upgrade timeline (default: all components)",
     )
     hi.set_defaults(func=cmd_history)
 

@@ -34,7 +34,7 @@ import copy
 import threading
 import time
 import uuid
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional
 
 from .errors import (
     AlreadyExistsError,

@@ -66,7 +66,7 @@ from http.client import (
     HTTPResponse,
     HTTPSConnection,
 )
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 from urllib.parse import quote, urlencode, urlparse
 
 from .. import metrics

@@ -33,7 +33,6 @@ from __future__ import annotations
 from typing import Any, Dict, Optional, Tuple
 
 from .errors import BadRequestError
-from .inmem import JsonObj
 
 #: (kind or "*", dotted field path) -> merge key.  The core subset of
 #: Kubernetes' struct-tag table that fleet tooling actually patches.

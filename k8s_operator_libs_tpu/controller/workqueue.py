@@ -27,7 +27,7 @@ import heapq
 import itertools
 import threading
 import time
-from typing import Any, Dict, Hashable, List, Optional, Set, Tuple
+from typing import Dict, Hashable, List, Optional, Set, Tuple
 
 
 class ShutDown(Exception):

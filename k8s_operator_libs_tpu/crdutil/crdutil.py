@@ -23,11 +23,11 @@ from __future__ import annotations
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Tuple
 
 import yaml
 
-from ..cluster.errors import ConflictError, NotFoundError
+from ..cluster.errors import NotFoundError
 from ..cluster.client import ClusterClient
 from ..cluster.retry import retry_on_conflict
 

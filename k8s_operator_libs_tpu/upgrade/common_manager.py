@@ -30,7 +30,6 @@ from typing import Dict, List, Optional
 from ..api.upgrade_spec import (
     DrainSpec,
     PodDeletionSpec,
-    UpgradePolicySpec,
     WaitForCompletionSpec,
 )
 from ..cluster.client import ClusterClient
@@ -48,7 +47,7 @@ from . import consts, util
 from .cordon_manager import CordonManager
 from .drain_manager import DrainConfiguration, DrainManager
 from .node_upgrade_state_provider import NodeUpgradeStateProvider
-from .pod_manager import PodManager, PodManagerConfig, PodManagerError
+from .pod_manager import PodManager, PodManagerConfig
 from .safe_driver_load_manager import SafeDriverLoadManager
 from .util import EventRecorder, log_event
 from .validation_manager import ValidationManager

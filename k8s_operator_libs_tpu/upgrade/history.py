@@ -75,6 +75,7 @@ def node_event_history(
     cluster,
     node: Optional[str] = None,
     namespaces: Optional[List[str]] = None,
+    component: Optional[str] = None,
 ) -> List[HistoryEntry]:
     """Collect Events about Nodes, newest last.
 
@@ -82,7 +83,14 @@ def node_event_history(
     the recorder's namespace — ``"default"`` unless the operator chose
     otherwise); None lists across all namespaces, which is what
     ``kubectl get events -A`` does and is the robust default when the
-    recorder's namespace is not known."""
+    recorder's namespace is not known.
+
+    *component*: keep only Events whose ``source.component`` matches —
+    on a real cluster Node events are mostly kubelet / node-controller
+    noise (NodeHasSufficientMemory, RegisteredNode, ...); pass the
+    operator's recorder component (``"<name>Upgrade"`` by default, see
+    :func:`~.util.get_event_reason`) to get the pure upgrade timeline.
+    None keeps everything (``kubectl get events`` behavior)."""
     events: List[dict] = []
     if namespaces:
         for ns in namespaces:
@@ -104,18 +112,27 @@ def node_event_history(
         name = involved.get("name") or ""
         if node is not None and name != node:
             continue
+        source_component = ((ev.get("source") or {}).get("component")) or ""
+        if component is not None and source_component != component:
+            continue
         key = f"{(ev.get('metadata') or {}).get('namespace', '')}/" + (
             (ev.get("metadata") or {}).get("name", "")
         )
+        # events.k8s.io-style writers fill eventTime and leave the legacy
+        # timestamps null — fall back so they sort and render correctly
         seen[key] = HistoryEntry(
             node=name,
             type=ev.get("type") or "",
             reason=ev.get("reason") or "",
             message=ev.get("message") or "",
             count=_int_or(ev.get("count"), 1),
-            first_timestamp=ev.get("firstTimestamp") or "",
-            last_timestamp=ev.get("lastTimestamp") or "",
-            component=((ev.get("source") or {}).get("component")) or "",
+            first_timestamp=ev.get("firstTimestamp")
+            or ev.get("eventTime")
+            or "",
+            last_timestamp=ev.get("lastTimestamp")
+            or ev.get("eventTime")
+            or "",
+            component=source_component,
         )
     out = list(seen.values())
     # ISO-8601 UTC strings order lexicographically; ties break on node

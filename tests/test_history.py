@@ -216,3 +216,55 @@ class TestHistoryReviewRegressions:
         node_event_history(Recording(), node="n1")
         assert calls[0] == "involvedObject.kind=Node,involvedObject.name=n1"
         assert calls[1] == ""  # fallback ran
+
+    def test_component_filter_drops_kubelet_noise(self):
+        """Real clusters fill Node events with kubelet/node-controller
+        noise; --source keeps the operator's upgrade timeline only."""
+        cluster = _rolled_cluster()
+        # a kubelet-style event about the same node
+        cluster.create(
+            {
+                "kind": "Event",
+                "metadata": {"name": "n0.kubelet1", "namespace": "default"},
+                "involvedObject": {"kind": "Node", "name": "n0"},
+                "reason": "NodeHasSufficientMemory",
+                "message": "Node n0 status is now: NodeHasSufficientMemory",
+                "type": "Normal",
+                "source": {"component": "kubelet"},
+                "count": 1,
+                "firstTimestamp": "2026-01-01T00:00:00Z",
+                "lastTimestamp": "2026-01-01T00:00:00Z",
+            }
+        )
+        from k8s_operator_libs_tpu.upgrade.history import node_event_history
+        from k8s_operator_libs_tpu.upgrade.util import get_event_reason
+
+        unfiltered = node_event_history(cluster)
+        assert any(e.component == "kubelet" for e in unfiltered)
+        filtered = node_event_history(cluster, component=get_event_reason())
+        assert filtered
+        assert all(e.component == get_event_reason() for e in filtered)
+
+    def test_event_time_fallback_for_new_style_events(self):
+        """events.k8s.io writers fill eventTime and leave the legacy
+        timestamps null — such events must sort and render, not collapse
+        to a blank first slot."""
+        cluster = _rolled_cluster()
+        cluster.create(
+            {
+                "kind": "Event",
+                "metadata": {"name": "n0.newstyle", "namespace": "default"},
+                "involvedObject": {"kind": "Node", "name": "n0"},
+                "reason": "Modern",
+                "message": "events.k8s.io-style",
+                "type": "Normal",
+                "source": {"component": "third-party"},
+                "eventTime": "2099-01-01T00:00:00Z",
+            }
+        )
+        from k8s_operator_libs_tpu.upgrade.history import node_event_history
+
+        entries = node_event_history(cluster)
+        modern = [e for e in entries if e.reason == "Modern"]
+        assert modern and modern[0].last_timestamp == "2099-01-01T00:00:00Z"
+        assert entries[-1].reason == "Modern"  # future stamp sorts last
