@@ -112,7 +112,15 @@ def node_event_history(
         name = involved.get("name") or ""
         if node is not None and name != node:
             continue
-        source_component = ((ev.get("source") or {}).get("component")) or ""
+        # events.k8s.io-style writers set reportingController and leave
+        # the deprecated source block empty — same writer class the
+        # timestamp fallback below handles
+        source_component = (
+            ((ev.get("source") or {}).get("component"))
+            or ev.get("reportingComponent")
+            or ev.get("reportingController")
+            or ""
+        )
         if component is not None and source_component != component:
             continue
         key = f"{(ev.get('metadata') or {}).get('namespace', '')}/" + (

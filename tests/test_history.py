@@ -258,7 +258,7 @@ class TestHistoryReviewRegressions:
                 "reason": "Modern",
                 "message": "events.k8s.io-style",
                 "type": "Normal",
-                "source": {"component": "third-party"},
+                "reportingController": "third-party.io/controller",
                 "eventTime": "2099-01-01T00:00:00Z",
             }
         )
@@ -267,4 +267,6 @@ class TestHistoryReviewRegressions:
         entries = node_event_history(cluster)
         modern = [e for e in entries if e.reason == "Modern"]
         assert modern and modern[0].last_timestamp == "2099-01-01T00:00:00Z"
+        # reportingController fallback (deprecated source block absent)
+        assert modern[0].component == "third-party.io/controller"
         assert entries[-1].reason == "Modern"  # future stamp sorts last

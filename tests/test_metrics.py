@@ -360,3 +360,52 @@ class TestWatchAndLeaderMetrics:
         # re-acquire mid-test; a voluntary stop records "released"
         assert 'leader_transitions_total{event="acquired"}' in out
         assert 'leader_transitions_total{event="released"} 1' in out
+
+
+class TestAlertRulesStayInSync:
+    def test_alert_rule_metrics_exist_in_exposition(self):
+        """hack/observability/alerts.yaml references real metric names —
+        a renamed metric must fail here, not silently dead-end alerts."""
+        import pathlib
+        import re
+
+        import yaml
+
+        from k8s_operator_libs_tpu import metrics as m
+
+        registry = m.MetricsRegistry()
+        prev = m.set_default_registry(registry)
+        try:
+            # touch every metric family the library can emit
+            m.record_state_transition("upgrade-done")
+            m.observe_reconcile("build", 0.01)
+            m.record_drain("ok", 1.0)
+            m.publish_rollout_gauges({"upgrade-done": 1}, 1, 0, 0, 0, 1)
+            m.record_watch_reconnect("Node")
+            m.record_watch_expired("Node")
+            m.record_held_queue_overflow()
+            m.set_held_queue_depth(0)
+            exposition = registry.render()
+        finally:
+            m.set_default_registry(prev)
+        exposed = set(re.findall(r"^([a-zA-Z_:][\w:]*)(?:\{| )", exposition, re.M))
+
+        rules = yaml.safe_load(
+            (
+                pathlib.Path(__file__).resolve().parents[1]
+                / "hack/observability/alerts.yaml"
+            ).read_text()
+        )
+        referenced = set()
+        for group in rules["groups"]:
+            for rule in group["rules"]:
+                referenced.update(
+                    re.findall(r"k8s_operator_libs_tpu_[\w]+", rule["expr"])
+                )
+        assert referenced, "no metrics referenced — parsing broke?"
+        missing = {
+            name
+            for name in referenced
+            if not any(e.startswith(name) for e in exposed)
+        }
+        assert missing == set(), f"alert rules reference unknown metrics: {missing}"
