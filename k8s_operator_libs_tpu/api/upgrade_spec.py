@@ -361,6 +361,13 @@ class UpgradePolicySpec:
     #: failed canary freezes the rollout (nothing further is admitted
     #: until it heals or is repaired).  0 = no canary stage.
     canary_domains: int = 0
+    #: Canary bake time: after every canary domain reaches upgrade-done,
+    #: hold the fleet closed for this many further seconds (latent driver
+    #: faults — ICI link flaps, slow memory errors — surface minutes
+    #: after a node reports healthy; production rollout systems bake
+    #: canaries for exactly this reason).  0 = open immediately.  Only
+    #: meaningful with canary_domains > 0.
+    canary_soak_seconds: float = 0
     #: Post-upgrade validation gate; None keeps whatever the consumer set
     #: via with_validation_enabled (builder back-compat).
     validation: Optional[ValidationSpec] = None
@@ -401,6 +408,7 @@ class UpgradePolicySpec:
         _require_non_negative("maxParallelUpgrades", self.max_parallel_upgrades)
         _require_non_negative("maxNodesPerHour", self.max_nodes_per_hour)
         _require_non_negative("canaryDomains", self.canary_domains)
+        _require_non_negative("canarySoakSeconds", self.canary_soak_seconds)
         _require_non_negative(
             "cacheSyncTimeoutSeconds", self.cache_sync_timeout_second
         )
@@ -454,6 +462,8 @@ class UpgradePolicySpec:
             out["maxNodesPerHour"] = self.max_nodes_per_hour
         if self.canary_domains:
             out["canaryDomains"] = self.canary_domains
+        if self.canary_soak_seconds:
+            out["canarySoakSeconds"] = self.canary_soak_seconds
         if self.validation is not None:
             out["validation"] = self.validation.to_dict()
         if self.slice_label_keys:
@@ -500,6 +510,7 @@ class UpgradePolicySpec:
             ),
             max_nodes_per_hour=d.get("maxNodesPerHour", 0),
             canary_domains=d.get("canaryDomains", 0),
+            canary_soak_seconds=d.get("canarySoakSeconds", 0),
             validation=(
                 ValidationSpec.from_dict(d["validation"])
                 if d.get("validation") is not None

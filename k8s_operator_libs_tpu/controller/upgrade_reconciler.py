@@ -107,6 +107,13 @@ class UpgradeReconciler:
     #: polling at the active cadence would hot-loop full fleet snapshots
     #: forever; a watch event on the fix wakes us sooner anyway
     failed_requeue_seconds: float = 5.0
+    #: requeue delay when work is PENDING but nothing is in flight — the
+    #: admissions are gated (canary bake window, closed maintenance
+    #: window, exhausted pacing, frozen canary), and nothing the cluster
+    #: does will change that before the gate's clock ticks; the active
+    #: cadence would burn ~72k full-fleet snapshots through one hour of
+    #: canarySoakSeconds doing no work
+    gated_requeue_seconds: float = 5.0
 
     def _current_policy(self) -> Optional[UpgradePolicySpec]:
         if self.policy_source is not None:
@@ -124,10 +131,16 @@ class UpgradeReconciler:
             return None
         self.manager.apply_state(state, policy)
         common = self.manager.common
-        if common.get_upgrades_in_progress(state) or common.get_upgrades_pending(
-            state
-        ):
+        if common.get_upgrades_in_progress(state):
             return Result(requeue_after=self.active_requeue_seconds)
+        if common.get_upgrades_pending(state):
+            # Pending with nothing in flight = gated admissions.  The
+            # snapshot was taken BEFORE apply_state's transitions, so a
+            # just-admitted wave still reports pending here — requeue at
+            # the gated cadence; the next pass sees it in progress and
+            # returns to the active cadence.  Fresh fleets spend exactly
+            # one classification pass here too (same one-cycle cost).
+            return Result(requeue_after=self.gated_requeue_seconds)
         if common.get_upgrades_failed(state):
             return Result(requeue_after=self.failed_requeue_seconds)
         return None

@@ -132,6 +132,15 @@ UPGRADE_VALIDATION_START_TIME_ANNOTATION_KEY_FMT = (
 #: (drives the max-nodes-per-hour pacing gate; see upgrade/schedule.py).
 UPGRADE_ADMITTED_AT_ANNOTATION_KEY_FMT = DOMAIN + "/%s-upgrade.admitted-at"
 
+#: Node annotation stamping when the node last reached upgrade-done
+#: (drives the canarySoakSeconds bake gate; written by the state
+#: provider in the SAME patch as the done label so the stamp can never
+#: be lost between two writes).  Never cleared — like admitted-at, a
+#: stale stamp from a previous rollout generation is harmless because
+#: the canary census only reads stamps of nodes currently in the done
+#: bucket.
+UPGRADE_DONE_AT_ANNOTATION_KEY_FMT = DOMAIN + "/%s-upgrade.done-at"
+
 #: Node annotation marking the admission as a throttle BYPASS (manually
 #: cordoned node, or straggler of an already-active domain).  Bypass
 #: admissions carry the admitted-at stamp — the canary census must see

@@ -101,11 +101,20 @@ class NodeUpgradeStateProvider:
         """
         name = (node.get("metadata") or {}).get("name", "")
         key = util.get_upgrade_state_label_key()
+        done_stamp = None
         with self._keyed_mutex.lock(name):
             if new_state == consts.UPGRADE_STATE_UNKNOWN:
                 patch: JsonObj = {"metadata": {"labels": {key: None}}}
             else:
                 patch = {"metadata": {"labels": {key: new_state}}}
+            if new_state == consts.UPGRADE_STATE_DONE:
+                # done-at rides the SAME patch as the label: two writes
+                # could be split by a crash, leaving a done node with no
+                # stamp and wedging a canarySoakSeconds gate forever
+                done_stamp = repr(time.time())
+                patch["metadata"]["annotations"] = {
+                    util.get_done_at_annotation_key(): done_stamp
+                }
             updated = self._cluster.patch("Node", name, patch)
             self._wait_or_defer(name, _rv_of(updated))
         node.setdefault("metadata", {}).setdefault("labels", {})
@@ -113,6 +122,10 @@ class NodeUpgradeStateProvider:
             node["metadata"]["labels"].pop(key, None)
         else:
             node["metadata"]["labels"][key] = new_state
+        if done_stamp is not None:
+            node["metadata"].setdefault("annotations", {})[
+                util.get_done_at_annotation_key()
+            ] = done_stamp
         metrics.record_state_transition(new_state)
         listener = getattr(self._local, "listener", None)
         if listener is not None:

@@ -303,6 +303,11 @@ def _evaluate_gates(state, policy) -> List[GateStatus]:
             )
         else:
             failed = sorted(census.failed_units)
+            detail = {
+                "succeeded": sorted(census.successful),
+                "inFlight": sorted(census.in_flight),
+                "failedDomains": failed,
+            }
             if failed:
                 reason = (
                     "canary FROZEN: "
@@ -310,9 +315,24 @@ def _evaluate_gates(state, policy) -> List[GateStatus]:
                     + " failed; nothing further is admitted until it "
                     "heals or is repaired"
                 )
+            elif census.soaking and not census.in_flight:
+                opens = (
+                    datetime.fromtimestamp(census.soak_until, timezone.utc)
+                    .replace(microsecond=0)
+                    .isoformat()
+                    .replace("+00:00", "Z")
+                )
+                reason = (
+                    f"canary baking: {len(census.soaking)} unit(s) done "
+                    f"({', '.join(sorted(census.soaking))}); fleet opens "
+                    f"at {opens} (canarySoakSeconds="
+                    f"{policy.canary_soak_seconds:g})"
+                )
+                detail["soaking"] = sorted(census.soaking)
+                detail["opensAt"] = opens
             else:
                 reason = (
-                    f"canary soaking: {len(census.in_flight)} unit(s) "
+                    f"canary in progress: {len(census.in_flight)} unit(s) "
                     f"in flight ({', '.join(sorted(census.in_flight))}); "
                     f"fleet opens when all "
                     f"{policy.canary_domains} succeed"
@@ -322,11 +342,7 @@ def _evaluate_gates(state, policy) -> List[GateStatus]:
                     gate="canary",
                     blocking=True,
                     reason=reason,
-                    detail={
-                        "succeeded": sorted(census.successful),
-                        "inFlight": sorted(census.in_flight),
-                        "failedDomains": failed,
-                    },
+                    detail=detail,
                 )
             )
 

@@ -50,20 +50,37 @@ class CanaryCensus:
     failed_units: frozenset
     #: Remaining fresh-unit admissions while the stage holds.
     remaining: int
-    #: True once enough units succeeded: the fleet is open.
+    #: True once enough units succeeded (and, with canarySoakSeconds,
+    #: finished baking): the fleet is open.
     passed: bool
+    #: Successful units still inside the canarySoakSeconds bake window.
+    soaking: frozenset = frozenset()
+    #: Wall-clock time the bake window ends (None when not soaking).
+    soak_until: Optional[float] = None
 
 
 def canary_census(
-    state: ClusterUpgradeState, policy: UpgradePolicySpec
+    state: ClusterUpgradeState,
+    policy: UpgradePolicySpec,
+    now: Optional[float] = None,
 ) -> CanaryCensus:
     """Compute the canary stage's exposure accounting (see
     :meth:`InplaceNodeStateManager._canary_budget` for the full
     semantics; this is its census, extracted pure so RolloutStatus can
-    explain a frozen canary — which unit failed — without a manager)."""
+    explain a frozen canary — which unit failed — without a manager).
+
+    With ``policy.canary_soak_seconds`` a successful unit only counts
+    toward opening the fleet once its newest member done-at stamp is
+    older than the soak window (the bake gate).  Nodes done WITHOUT a
+    stamp (upgraded before the stamp existed) count as already soaked —
+    degrading open, never wedging the gate forever."""
+    import time as _time
+
     from ..cluster.objects import get_annotation, name_of
 
     key = util.get_admitted_at_annotation_key()
+    done_key = util.get_done_at_annotation_key()
+    now_ts = _time.time() if now is None else now
 
     def unit_of(node):
         if policy.slice_aware:
@@ -74,6 +91,7 @@ def canary_census(
     stamped = set()
     not_done = set()
     failed_units = set()
+    done_at: dict = {}  # unit -> newest member done-at stamp
     for bucket, node_states in state.node_states.items():
         if bucket not in consts.ALL_STATES:
             continue
@@ -83,11 +101,30 @@ def canary_census(
                 stamped.add(unit)
             if bucket != consts.UPGRADE_STATE_DONE:
                 not_done.add(unit)
+            else:
+                raw = get_annotation(ns.node, done_key)
+                try:
+                    ts = float(raw) if raw else 0.0
+                except ValueError:
+                    ts = 0.0
+                done_at[unit] = max(done_at.get(unit, 0.0), ts)
             if bucket == consts.UPGRADE_STATE_FAILED:
                 failed_units.add(unit)
     successful = stamped - not_done
     in_flight = stamped - successful
-    passed = len(successful) >= policy.canary_domains
+    soak = policy.canary_soak_seconds
+    soaking = set()
+    soak_until = None
+    if soak > 0:
+        soaking = {
+            u
+            for u in successful
+            if now_ts - done_at.get(u, 0.0) < soak
+        }
+        if soaking:
+            soak_until = max(done_at[u] for u in soaking) + soak
+    baked = successful - soaking
+    passed = len(baked) >= policy.canary_domains
     return CanaryCensus(
         stamped=frozenset(stamped),
         successful=frozenset(successful),
@@ -95,6 +132,8 @@ def canary_census(
         failed_units=frozenset(in_flight & failed_units),
         remaining=max(0, policy.canary_domains - len(stamped)),
         passed=passed,
+        soaking=frozenset(soaking),
+        soak_until=soak_until,
     )
 
 

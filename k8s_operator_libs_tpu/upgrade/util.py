@@ -186,6 +186,11 @@ def get_admitted_at_annotation_key() -> str:
     return consts.UPGRADE_ADMITTED_AT_ANNOTATION_KEY_FMT % get_component_name()
 
 
+def get_done_at_annotation_key() -> str:
+    """Done timestamp (canary soak gate) annotation key."""
+    return consts.UPGRADE_DONE_AT_ANNOTATION_KEY_FMT % get_component_name()
+
+
 def get_admitted_bypass_annotation_key() -> str:
     """Throttle-bypass admission marker (pacing-exempt) annotation key."""
     return (

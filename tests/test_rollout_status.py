@@ -302,7 +302,9 @@ class TestGateReasons:
         gates = {g.gate: g for g in status.gates}
         assert gates["canary"].blocking is True
         assert gates["canary"].detail["failedDomains"] == []
-        assert "soaking" in gates["canary"].reason
+        # "in progress" = units mid-flight; "baking"/"soaking" now names
+        # the canarySoakSeconds window after they succeed
+        assert "in progress" in gates["canary"].reason
 
     def test_closed_window_gate_reports_next_open(
         self, cluster, monkeypatch

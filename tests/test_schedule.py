@@ -708,3 +708,157 @@ class TestNextOpenMath:
                 util.get_admitted_bypass_annotation_key()
             ] = "true"
         assert schedule.next_pacing_slot_at(nodes, 1, now_ts=now) is None
+
+
+class TestCanarySoak:
+    """canarySoakSeconds: after the canary domains reach done, the fleet
+    stays closed for a bake window (latent faults surface late); the
+    done-at stamp rides the same patch as the done label."""
+
+    SLICE_KEY = consts.SLICE_ID_LABEL_KEYS[0]
+
+    def _fleet(self, cluster, slices=3, hosts=2):
+        fleet = Fleet(cluster)
+        for s in range(slices):
+            for h in range(hosts):
+                fleet.add_node(
+                    f"s{s}-h{h}",
+                    pod_hash="rev1",
+                    labels={self.SLICE_KEY: f"s{s}"},
+                )
+        fleet.publish_new_revision("rev2")
+        return fleet
+
+    def _policy(self, **kw):
+        base = dict(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            slice_aware=True,
+            canary_domains=1,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        base.update(kw)
+        return UpgradePolicySpec(**base)
+
+    def _run_canary_to_done(self, cluster, fleet, manager, policy):
+        for _ in range(20):
+            _reconcile(manager, fleet, policy)
+            done_domains = {
+                n.split("-")[0]
+                for n, s in fleet.states().items()
+                if s == consts.UPGRADE_STATE_DONE
+            }
+            if done_domains:
+                return done_domains
+        raise AssertionError(f"canary never finished: {fleet.states()}")
+
+    def test_done_at_stamp_written_with_done_label(self, cluster):
+        fleet = self._fleet(cluster, slices=1)
+        manager = _make_manager(cluster)
+        policy = self._policy(canary_domains=0)
+        for _ in range(20):
+            _reconcile(manager, fleet, policy)
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        key = util.get_done_at_annotation_key()
+        for node in cluster.list("Node"):
+            raw = (node["metadata"].get("annotations") or {}).get(key)
+            assert raw, f"missing done-at on {node['metadata']['name']}"
+            assert float(raw) > 0
+
+    def test_fleet_held_closed_during_bake_then_opens(
+        self, cluster, monkeypatch
+    ):
+        # A huge soak window avoids real-clock races on slow CI hosts;
+        # the "window elapses" half advances the clock by monkeypatching
+        # time.time (canary_census reads it), not by sleeping.
+        fleet = self._fleet(cluster)
+        manager = _make_manager(cluster)
+        policy = self._policy(canary_soak_seconds=3600.0)
+        self._run_canary_to_done(cluster, fleet, manager, policy)
+        # the canary is done — but the fleet must NOT open while baking
+        for _ in range(3):
+            _reconcile(manager, fleet, policy)
+        non_canary_started = {
+            n
+            for n, s in fleet.states().items()
+            if s
+            not in (
+                consts.UPGRADE_STATE_UPGRADE_REQUIRED,
+                consts.UPGRADE_STATE_DONE,
+            )
+        }
+        assert non_canary_started == set(), (
+            f"fleet opened during bake: {fleet.states()}"
+        )
+        real_time = time.time
+        monkeypatch.setattr(time, "time", lambda: real_time() + 3601.0)
+        for _ in range(30):
+            _reconcile(manager, fleet, policy)
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+
+    def test_census_soak_math_with_injected_clock(self, cluster):
+        from k8s_operator_libs_tpu.upgrade.upgrade_inplace import canary_census
+
+        fleet = self._fleet(cluster)
+        manager = _make_manager(cluster)
+        policy = self._policy(canary_soak_seconds=3600.0)
+        self._run_canary_to_done(cluster, fleet, manager, policy)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        census_now = canary_census(state, policy)
+        assert not census_now.passed
+        assert census_now.soaking and census_now.soak_until is not None
+        # an hour later the same snapshot passes
+        census_later = canary_census(
+            state, policy, now=time.time() + 3601.0
+        )
+        assert census_later.passed
+        assert not census_later.soaking
+
+    def test_status_gate_explains_baking(self, cluster):
+        from k8s_operator_libs_tpu.upgrade import RolloutStatus
+
+        fleet = self._fleet(cluster)
+        manager = _make_manager(cluster)
+        policy = self._policy(canary_soak_seconds=3600.0)
+        self._run_canary_to_done(cluster, fleet, manager, policy)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        status = RolloutStatus.from_cluster_state(state, policy=policy)
+        canary_gate = next(g for g in status.gates if g.gate == "canary")
+        assert canary_gate.blocking
+        assert "baking" in canary_gate.reason
+        assert "opensAt" in canary_gate.detail
+
+    def test_missing_stamp_degrades_open(self, cluster):
+        """Nodes done before the stamp existed count as already soaked —
+        the gate degrades open instead of wedging forever."""
+        from k8s_operator_libs_tpu.upgrade.upgrade_inplace import canary_census
+
+        fleet = self._fleet(cluster)
+        manager = _make_manager(cluster)
+        policy = self._policy(canary_soak_seconds=3600.0)
+        self._run_canary_to_done(cluster, fleet, manager, policy)
+        # strip the stamps (simulating an older-version rollout)
+        key = util.get_done_at_annotation_key()
+        for node in cluster.list("Node"):
+            annotations = node["metadata"].get("annotations") or {}
+            if key in annotations:
+                del annotations[key]
+                cluster.update(node)
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        census = canary_census(state, policy)
+        assert census.passed
+
+    def test_policy_round_trip_and_validation(self):
+        from k8s_operator_libs_tpu.api import ValidationError
+        import pytest as _pytest
+
+        p = self._policy(canary_soak_seconds=120.5)
+        d = p.to_dict()
+        assert d["canarySoakSeconds"] == 120.5
+        assert UpgradePolicySpec.from_dict(d).canary_soak_seconds == 120.5
+        with _pytest.raises(ValidationError):
+            self._policy(canary_soak_seconds=-1).validate()
