@@ -158,6 +158,7 @@ def new_upgrade_controller(
     resync_seconds: float = 1.0,
     active_requeue_seconds: float = 0.05,
     failed_requeue_seconds: float = 5.0,
+    gated_requeue_seconds: float = 5.0,
     watch_poll_seconds: float = 0.005,
 ) -> Controller:
     """Assemble the standard operator: watches on Nodes, driver Pods,
@@ -185,6 +186,7 @@ def new_upgrade_controller(
         policy_source=policy_source,
         active_requeue_seconds=active_requeue_seconds,
         failed_requeue_seconds=failed_requeue_seconds,
+        gated_requeue_seconds=gated_requeue_seconds,
     )
     controller = Controller(
         cluster,
