@@ -147,6 +147,23 @@ class _Handler(BaseHTTPRequestHandler):
         return route, query
 
     def _dispatch(self, method: str) -> None:
+        # Chaos injection (ApiServerFacade.with_chaos): a fraction of
+        # requests is dropped with an abrupt connection close BEFORE
+        # processing — the client sees ConnectionError/IncompleteRead,
+        # the operation was never applied, and the operator's retry /
+        # next-reconcile idempotency must absorb it.  (Rate is seeded;
+        # the PATTERN is thread-scheduling dependent — see with_chaos.)
+        ratio = getattr(self, "chaos_drop_ratio", 0.0)
+        rng = getattr(self, "chaos_rng", None)
+        if ratio and rng is not None and rng.random() < ratio:
+            self.close_connection = True
+            try:
+                import socket as _socket
+
+                self.connection.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return
         try:
             self._check_auth()
             (info, namespace, name, subresource), query = self._route()
@@ -433,14 +450,30 @@ class ApiServerFacade:
         #: Mutable: tests rotate the accepted set mid-run to force 401s
         #: (exec-plugin refresh path).  None = no auth required.
         self.accepted_tokens = accepted_tokens
-        handler = type(
+        self._handler_cls = type(
             "BoundHandler",
             (_Handler,),
             {"cluster": cluster, "accepted_tokens": accepted_tokens},
         )
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), self._handler_cls)
         self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
+
+    def with_chaos(self, drop_ratio: float, seed: int = 0) -> "ApiServerFacade":
+        """Drop a fraction of requests with an abrupt connection close
+        before they are processed (fault injection for the
+        client/operator retry paths).  Chainable; ratio 0 disables.
+
+        The seed pins the statistical RATE, not the drop pattern: the
+        RNG is shared across handler threads, so thread scheduling
+        decides which request consumes which draw.  Chaos consumers must
+        assert properties that hold for any drop pattern (convergence,
+        legal transitions), never a specific sequence."""
+        import random as _random
+
+        self._handler_cls.chaos_drop_ratio = drop_ratio
+        self._handler_cls.chaos_rng = _random.Random(seed)
+        return self
 
     @property
     def url(self) -> str:
