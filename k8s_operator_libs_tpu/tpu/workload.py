@@ -46,6 +46,16 @@ class ModelConfig:
     #: attention / reduce-scatter after it — long sequences then cost
     #: 1/sp of the activation memory outside attention.
     seq_axis: Any = None
+    #: Mixture-of-experts width (0 = dense MLP).  The MoE layer is
+    #: soft-gated (every expert computes, the router weights the sum):
+    #: shapes stay static under jit — the compiler-friendly choice; a
+    #: token-dropping top-k dispatch would need the ragged all-to-all
+    #: real MoE stacks hand-roll.  Expert weights carry a leading
+    #: experts dim always sharded over the mesh's ``expert`` axis
+    #: (expert parallelism; a size-1 axis IS replication, so there is
+    #: no separate toggle).  ``n_experts`` must be divisible by the
+    #: mesh's ep factor.  XLA reduces the expert-sharded einsum over ICI.
+    n_experts: int = 0
 
 
 import threading as _threading
@@ -71,6 +81,46 @@ def _seq_constrain(x, cfg: "ModelConfig", seq_sharded: bool):
     return jax.lax.with_sharding_constraint(x, spec)
 
 
+class MoeMlp(nn.Module):
+    """Soft-gated mixture-of-experts MLP (expert parallelism).
+
+    Every expert computes every token; the router's softmax weights the
+    sum.  Static shapes under jit, and the experts dimension of the
+    stacked weights shards over the mesh's ``expert`` axis — each device
+    holds and computes ONLY its local experts, XLA inserting the
+    reduction across the expert axis.  (A token-dropping top-k dispatch
+    — the capacity-factor design — trades this simplicity for a ragged
+    all-to-all; for the demo workload soft gating exercises the same
+    sharding/collective structure without dynamic shapes.)"""
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.config
+        e, d, f = cfg.n_experts, cfg.d_model, cfg.d_ff
+        gate = nn.Dense(e, dtype=cfg.dtype, name="router")(h)  # (B,S,E)
+        gates = jax.nn.softmax(gate.astype(jnp.float32), axis=-1).astype(
+            cfg.dtype
+        )
+        w_up = self.param(
+            "experts_up",
+            nn.initializers.lecun_normal(),
+            (e, d, f),
+            cfg.dtype,
+        )
+        w_down = self.param(
+            "experts_down",
+            nn.initializers.lecun_normal(),
+            (e, f, d),
+            cfg.dtype,
+        )
+        up = jnp.einsum("bsd,edf->bsef", h, w_up)
+        act = nn.gelu(up)
+        down = jnp.einsum("bsef,efd->bsed", act, w_down)
+        return jnp.einsum("bsed,bse->bsd", down, gates)
+
+
 class Block(nn.Module):
     """Pre-LN transformer block with causal self-attention."""
 
@@ -94,9 +144,12 @@ class Block(nn.Module):
         # elementwise + MLP region: re-shard over the sequence axis
         x = _seq_constrain(x, cfg, seq_sharded=True)
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_mlp")(x)
-        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="mlp_up")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_down")(h)
+        if cfg.n_experts > 0:
+            h = MoeMlp(cfg, name="moe")(h)
+        else:
+            h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="mlp_up")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_down")(h)
         return x + h
 
 
@@ -130,20 +183,25 @@ def make_mesh(
     dp: Optional[int] = None,
     tp: Optional[int] = None,
     sp: int = 1,
+    ep: int = 1,
 ) -> Mesh:
-    """A (data, seq, model) mesh.  ``sp=1`` (default) degenerates to the
-    plain dp×tp layout; with ``sp>1`` pass a config with
-    ``seq_axis="seq"`` so activations shard over the sequence dimension.
-    Callers pick explicit dp×sp×tp for real topologies."""
+    """A (data, seq, model, expert) mesh.  ``sp=1``/``ep=1`` (defaults)
+    degenerate those axes; with ``sp>1`` pass a config with
+    ``seq_axis="seq"``, with ``ep>1`` one with ``n_experts`` divisible
+    by ``ep`` (expert weights always shard over the expert axis; size 1
+    = replication).  Callers pick explicit dp×sp×tp×ep for real
+    topologies."""
     devices = jax.devices()
     n = n_devices or len(devices)
     if dp is None or tp is None:
         tp = tp or (2 if n % 2 == 0 and n > 1 else 1)
-        dp = dp or n // (tp * sp)
-    if dp * sp * tp != n:
-        raise ValueError(f"dp({dp}) * sp({sp}) * tp({tp}) != devices({n})")
-    dev_array = np.array(devices[:n]).reshape(dp, sp, tp)
-    return Mesh(dev_array, axis_names=("data", "seq", "model"))
+        dp = dp or n // (tp * sp * ep)
+    if dp * sp * tp * ep != n:
+        raise ValueError(
+            f"dp({dp}) * sp({sp}) * tp({tp}) * ep({ep}) != devices({n})"
+        )
+    dev_array = np.array(devices[:n]).reshape(dp, sp, tp, ep)
+    return Mesh(dev_array, axis_names=("data", "seq", "model", "expert"))
 
 
 def param_partition_spec(path: Tuple[str, ...], leaf: jax.Array) -> P:
@@ -154,6 +212,13 @@ def param_partition_spec(path: Tuple[str, ...], leaf: jax.Array) -> P:
     names = "/".join(str(p) for p in path)
     if leaf.ndim < 2:
         return P()
+    # Expert parallelism: stacked (E, d, f)/(E, f, d) expert weights
+    # shard the experts dim over "expert" AND keep the tensor-parallel
+    # split of the hidden dim over "model" — EP and TP compose.
+    if "experts_up" in names:
+        return P("expert", None, "model")
+    if "experts_down" in names:
+        return P("expert", "model", None)
     if "mlp_up" in names or ("attn" in names and "out" not in names):
         return P(None, "model") if leaf.ndim == 2 else P(None, None, "model")
     if "mlp_down" in names or ("attn" in names and "out" in names):

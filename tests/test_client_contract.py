@@ -2051,22 +2051,32 @@ class TestFlakyApiserverChaos:
                 from k8s_operator_libs_tpu.upgrade import util as _util
 
                 state_key = _util.get_upgrade_state_label_key()
-                for node in store.list("Node"):
-                    labels = node["metadata"].get("labels") or {}
-                    if labels.get(state_key) != consts.UPGRADE_STATE_FAILED:
-                        continue
-                    for pod in store.list("Pod", NAMESPACE):
-                        if (pod.get("spec") or {}).get("nodeName") == node[
-                            "metadata"
-                        ]["name"]:
-                            store.delete(
-                                "Pod",
-                                pod["metadata"]["name"],
-                                NAMESPACE,
-                                grace_period_seconds=0,
-                            )
+
+                def repair_failed_nodes() -> None:
+                    # replace the driver pod of any drain-failed node so
+                    # the DS recreates it at the target revision and the
+                    # node self-heals.  Runs INSIDE the polling loop: a
+                    # chaos-era drain failure can land a few ms after
+                    # chaos is disabled (the controller processes the
+                    # dropped call's outcome asynchronously).
+                    for node in store.list("Node"):
+                        labels = node["metadata"].get("labels") or {}
+                        if labels.get(state_key) != consts.UPGRADE_STATE_FAILED:
+                            continue
+                        for pod in store.list("Pod", NAMESPACE):
+                            if (pod.get("spec") or {}).get("nodeName") == node[
+                                "metadata"
+                            ]["name"]:
+                                store.delete(
+                                    "Pod",
+                                    pod["metadata"]["name"],
+                                    NAMESPACE,
+                                    grace_period_seconds=0,
+                                )
+
                 deadline = time.monotonic() + 30.0
                 while time.monotonic() < deadline:
+                    repair_failed_nodes()
                     fleet.reconcile_daemonset()
                     if set(fleet.states().values()) == {
                         consts.UPGRADE_STATE_DONE
