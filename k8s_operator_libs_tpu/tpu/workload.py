@@ -305,6 +305,181 @@ def make_batch(config: ModelConfig, batch_size: int, seed: int = 0):
     )
 
 
+# ---------------------------------------------------- pipeline parallelism
+
+
+def make_pipeline_mesh(n_stages: int) -> Mesh:
+    """A 1-D ``("stage",)`` mesh for GPipe-style pipeline parallelism.
+    Kept separate from the dp×sp×tp×ep mesh: the pipeline demo trades
+    composition for a readable schedule (production stacks compose pp
+    with dp by adding the stage axis to the big mesh)."""
+    devices = jax.devices()
+    if len(devices) < n_stages:
+        raise ValueError(f"need {n_stages} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:n_stages]), axis_names=("stage",))
+
+
+def stack_block_params(params, n_layers: int):
+    """Split a TinyLM param tree into (stage-stacked block params, rest).
+
+    The blocks have identical shapes, so ``block_0..block_{L-1}``
+    subtrees stack into one tree whose leaves carry a leading stage dim
+    — shardable ``P("stage")`` so each pipeline stage holds ONLY its own
+    layer's weights (the whole point of pp: the model need not fit on
+    one chip)."""
+    blocks = [params[f"block_{i}"] for i in range(n_layers)]
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *blocks
+    )
+    rest = {k: v for k, v in params.items() if not k.startswith("block_")}
+    return stacked, rest
+
+
+def _unstack_first(tree):
+    """Drop the size-1 leading dim shard_map leaves carry per stage."""
+    return jax.tree_util.tree_map(lambda x: x[0], tree)
+
+
+def pipeline_blocks_apply(
+    config: ModelConfig, mesh: Mesh, stacked_blocks, x, n_microbatches: int
+):
+    """Run the block stack as a GPipe pipeline over the ``stage`` axis.
+
+    *x* is the embedded activation ``(B, S, D)``; it is split into
+    ``n_microbatches`` microbatches that flow through the stages with a
+    ``lax.scan`` over ``M + S - 1`` ticks: every tick each stage applies
+    ITS block to its current microbatch, then ``ppermute`` rotates
+    activations downstream (the classic bubble schedule — the first
+    S-1 ticks fill the pipe, the last S-1 drain it).  Differentiable
+    end to end: scan/where/ppermute all transpose cleanly, so
+    ``jax.grad`` yields the pipelined backward pass for free.
+
+    Demo scope: one block per stage (``n_layers == n_stages``)."""
+    from jax.experimental.shard_map import shard_map
+
+    block = Block(config)
+    n_stages = mesh.shape["stage"]
+    if config.n_layers != n_stages:
+        # shard_map would split a (n_layers, ...) stack over n_stages and
+        # _unstack_first would keep only each stage's first slice —
+        # silently computing a SHALLOWER model.  Demo scope is one block
+        # per stage; fail loudly instead.
+        raise ValueError(
+            f"pipeline demo runs one block per stage: n_layers "
+            f"({config.n_layers}) must equal the stage-mesh size "
+            f"({n_stages})"
+        )
+    batch, seqlen, d = x.shape
+    if batch % n_microbatches:
+        raise ValueError(
+            f"batch {batch} not divisible into {n_microbatches} microbatches"
+        )
+    micro = x.reshape(n_microbatches, batch // n_microbatches, seqlen, d)
+
+    def stage_program(blocks, micro_in):
+        blocks = _unstack_first(blocks)
+        stages = jax.lax.psum(1, "stage")
+        idx = jax.lax.axis_index("stage")
+        m = micro_in.shape[0]
+        ticks = m + stages - 1
+
+        def tick(carry, t):
+            buf, outs = carry
+            feed = micro_in[jnp.clip(t, 0, m - 1)]
+            x_in = jnp.where(idx == 0, feed, buf)
+            y = block.apply({"params": blocks}, x_in)
+            out_t = t - (stages - 1)
+            outs = jax.lax.cond(
+                (idx == stages - 1) & (out_t >= 0),
+                lambda o: o.at[jnp.clip(out_t, 0, m - 1)].set(y),
+                lambda o: o,
+                outs,
+            )
+            nxt = jax.lax.ppermute(
+                y, "stage", [(k, (k + 1) % stages) for k in range(stages)]
+            )
+            return (nxt, outs), None
+
+        # scan carries must be stage-VARYING from tick 0 (they hold
+        # per-stage activations after the first ppermute); pvary marks
+        # the zero-init accordingly or the cond/scan types mismatch
+        init = (
+            jax.lax.pvary(
+                jnp.zeros(micro_in.shape[1:], micro_in.dtype), ("stage",)
+            ),
+            jax.lax.pvary(jnp.zeros_like(micro_in), ("stage",)),
+        )
+        (_, outs), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
+        # keep a leading stage dim so the out_spec can place it; only the
+        # LAST stage's buffer holds the real outputs
+        return outs[None]
+
+    outs = shard_map(
+        stage_program,
+        mesh=mesh,
+        in_specs=(P("stage"), P()),
+        out_specs=P("stage"),
+    )(stacked_blocks, micro)
+    return outs[-1].reshape(batch, seqlen, d)
+
+
+def pipeline_loss_fn(
+    config: ModelConfig,
+    mesh: Mesh,
+    stacked_blocks,
+    rest_params,
+    tokens,
+    n_microbatches: int = 2,
+):
+    """Next-token loss with the block stack pipelined over stages.
+    Embedding / final LN / head run replicated outside the shard_map
+    (they are cheap; pipelining them would complicate the demo without
+    changing the schedule's structure).  Must agree exactly with the
+    sequential :func:`loss_fn` for identical params — the equivalence
+    the tests pin."""
+    inputs = tokens[:, :-1]
+    x = nn.Embed(
+        config.vocab_size, config.d_model, dtype=config.dtype
+    ).apply({"params": rest_params["embed"]}, inputs)
+    pos = nn.Embed(
+        config.max_seq_len, config.d_model, dtype=config.dtype
+    ).apply({"params": rest_params["pos_embed"]}, jnp.arange(inputs.shape[1])[None, :])
+    x = x + pos
+    x = pipeline_blocks_apply(config, mesh, stacked_blocks, x, n_microbatches)
+    x = nn.LayerNorm(dtype=config.dtype).apply(
+        {"params": rest_params["ln_f"]}, x
+    )
+    logits = nn.Dense(config.vocab_size, dtype=config.dtype).apply(
+        {"params": rest_params["lm_head"]}, x
+    )
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_pipeline_train_step(
+    config: ModelConfig, mesh: Mesh, tx, n_microbatches: int = 2
+):
+    """Jit-compiled pipelined train step over (stacked_blocks, rest)."""
+    import optax
+
+    def step(stacked_blocks, rest_params, opt_states, tokens):
+        def loss_of(both):
+            return pipeline_loss_fn(
+                config, mesh, both[0], both[1], tokens, n_microbatches
+            )
+
+        loss, grads = jax.value_and_grad(loss_of)((stacked_blocks, rest_params))
+        updates, opt_states = tx.update(grads, opt_states, (stacked_blocks, rest_params))
+        stacked_blocks, rest_params = optax.apply_updates(
+            (stacked_blocks, rest_params), updates
+        )
+        return stacked_blocks, rest_params, opt_states, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
 # ------------------------------------------------------------ orbax wiring
 
 
