@@ -28,6 +28,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from typing import Optional, Tuple
 
 from .cluster.inmem import InMemoryCluster
@@ -153,37 +154,72 @@ def cmd_status(args: argparse.Namespace) -> int:
     cluster, rc = _open_source(args, "status")
     if cluster is None:
         return rc
+    if args.watch and args.state_file:
+        print(
+            "--watch needs a live source (--kubeconfig/--in-cluster); "
+            "a state-file dump never changes",
+            file=sys.stderr,
+        )
+        return 2
     util.set_component_name(args.component)
     from .cluster.errors import ApiError
     from .upgrade.upgrade_state import UpgradeStateError
 
     manager = ClusterUpgradeStateManager(cluster)
-    try:
-        state = manager.build_state(
-            args.namespace, _parse_selector_arg(args.selector)
+    policy = None
+    gates_noted = False
+    last_rendered = None
+    while True:
+        try:
+            state = manager.build_state(
+                args.namespace, _parse_selector_arg(args.selector)
+            )
+        except (ApiError, OSError, UpgradeStateError) as err:
+            # Unreachable apiserver / auth failure / 5xx / inconsistent
+            # snapshot (unscheduled driver pods) must keep the documented
+            # exit-code contract (2 = cannot read the source), not escape
+            # as a traceback.  In watch mode a transient error is part of
+            # the deal (mid-restart-wave snapshots) — report and keep
+            # watching.
+            if not args.watch:
+                print(f"cannot read cluster state: {err}", file=sys.stderr)
+                return 2
+            print(
+                f"(transient) cannot read cluster state: {err}",
+                file=sys.stderr,
+            )
+            time.sleep(args.interval)
+            continue
+        # The policy is (re)read EVERY iteration in watch mode: a watch
+        # outlives CR edits (the operator honors them live — status must
+        # agree) and a transient read failure must not permanently
+        # disable gate evaluation; a failed read keeps the last good
+        # policy, mirroring CrPolicySource.
+        loaded, prc = _load_policy_cr(args, cluster)
+        if prc:
+            if not args.watch:
+                return prc
+        elif loaded is not None:
+            policy = loaded
+        if args.policy and policy is None and not gates_noted:
+            print("gates not evaluated", file=sys.stderr)
+            gates_noted = True
+        if policy is not None:
+            _push_topology_keys(policy)
+        status = RolloutStatus.from_cluster_state(state, policy=policy)
+        rendered = (
+            json.dumps(status.to_dict()) if args.json else status.render()
         )
-    except (ApiError, OSError, UpgradeStateError) as err:
-        # Unreachable apiserver / auth failure / 5xx / inconsistent
-        # snapshot (unscheduled driver pods) must keep the documented
-        # exit-code contract (2 = cannot read the source), not escape as
-        # a traceback.
-        print(f"cannot read cluster state: {err}", file=sys.stderr)
-        return 2
-    policy, rc = _load_policy_cr(args, cluster)
-    if rc:
-        return rc
-    if args.policy and policy is None:
-        print("gates not evaluated", file=sys.stderr)
-    if policy is not None:
-        _push_topology_keys(policy)
-    status = RolloutStatus.from_cluster_state(state, policy=policy)
-    if args.json:
-        print(json.dumps(status.to_dict()))
-    else:
-        print(status.render())
-    # kubectl-rollout-status convention: nonzero while not complete lets
-    # scripts poll `status` until the rollout finishes
-    return 0 if status.complete or not args.wait_exit_code else 3
+        if rendered != last_rendered:
+            print(rendered, flush=True)
+            last_rendered = rendered
+        if not args.watch:
+            # kubectl-rollout-status convention: nonzero while not
+            # complete lets scripts poll until the rollout finishes
+            return 0 if status.complete or not args.wait_exit_code else 3
+        if status.complete:
+            return 0  # kubectl rollout status: block until done, then 0
+        time.sleep(args.interval)
 
 
 def cmd_plan(args: argparse.Namespace) -> int:
@@ -358,6 +394,19 @@ def main(argv=None) -> int:
         action="store_true",
         help="exit 3 while the rollout is incomplete (poll-friendly)",
     )
+    st.add_argument(
+        "--watch",
+        action="store_true",
+        help="block until the rollout completes, printing the status "
+        "whenever it changes (kubectl rollout status behavior; live "
+        "sources only)",
+    )
+    st.add_argument(
+        "--interval",
+        type=float,
+        default=2.0,
+        help="poll interval for --watch (seconds)",
+    )
     st.set_defaults(func=cmd_status)
 
     pl = sub.add_parser(
@@ -438,6 +487,10 @@ def main(argv=None) -> int:
         # would misread as "rollout complete".
         sys.stderr.close()
         return 141
+    except KeyboardInterrupt:
+        # Ctrl-C is how a user leaves --watch: exit 130 (128+SIGINT)
+        # cleanly, no traceback — kubectl rollout status behavior.
+        return 130
 
 
 if __name__ == "__main__":

@@ -258,13 +258,19 @@ def create_train_state(
     return model, params, tx, opt_state
 
 
-def loss_fn(model: TinyLM, params, tokens):
-    """Next-token cross-entropy (teacher-forced causal LM)."""
-    logits = model.apply({"params": params}, tokens[:, :-1])
-    targets = tokens[:, 1:]
+def _token_nll(logits, targets):
+    """Mean next-token negative log-likelihood — the ONE loss
+    definition, shared by the sequential and pipelined paths so they
+    cannot drift (the pipeline equivalence test compares them)."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
+
+
+def loss_fn(model: TinyLM, params, tokens):
+    """Next-token cross-entropy (teacher-forced causal LM)."""
+    logits = model.apply({"params": params}, tokens[:, :-1])
+    return _token_nll(logits, tokens[:, 1:])
 
 
 def make_train_step(model: TinyLM, tx, mesh: Optional[Mesh] = None):
@@ -452,10 +458,7 @@ def pipeline_loss_fn(
     logits = nn.Dense(config.vocab_size, dtype=config.dtype).apply(
         {"params": rest_params["lm_head"]}, x
     )
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    return _token_nll(logits, tokens[:, 1:])
 
 
 def make_pipeline_train_step(

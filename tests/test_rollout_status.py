@@ -590,3 +590,122 @@ class TestStatusCliLiveMode:
         )
         assert rc == 2
         assert "ONE source" in capsys.readouterr().err
+
+
+class TestStatusWatchMode:
+    """status --watch: block until the rollout completes, printing on
+    change (kubectl rollout status behavior)."""
+
+    def _kubeconfig(self, tmp_path, url):
+        kc = tmp_path / "kubeconfig"
+        kc.write_text(
+            "\n".join(
+                [
+                    "apiVersion: v1",
+                    "kind: Config",
+                    "current-context: t",
+                    "contexts:",
+                    "- name: t",
+                    "  context: {cluster: t, user: t}",
+                    "clusters:",
+                    f"- name: t\n  cluster: {{server: {url}}}",
+                    "users:",
+                    "- name: t\n  user: {token: x}",
+                ]
+            )
+        )
+        return str(kc)
+
+    def test_watch_rejects_state_file(self, cluster, tmp_path, capsys):
+        dump = tmp_path / "d.json"
+        dump.write_text(json.dumps(cluster.to_dict()))
+        rc = cli_main(
+            ["status", "--state-file", str(dump), "--watch"]
+        )
+        assert rc == 2
+        assert "live source" in capsys.readouterr().err
+
+    def test_watch_blocks_until_complete(self, cluster, tmp_path, capsys):
+        import threading
+
+        from k8s_operator_libs_tpu.api import (
+            DrainSpec,
+            IntOrString,
+            UpgradePolicySpec,
+        )
+        from k8s_operator_libs_tpu.cluster import ApiServerFacade
+        from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+            ClusterUpgradeStateManager,
+        )
+
+        fleet = Fleet(cluster)
+        for i in range(2):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+
+        roll_errors = []
+
+        def roll():
+            try:
+                manager = ClusterUpgradeStateManager(
+                    cluster,
+                    cache_sync_timeout_seconds=2.0,
+                    cache_sync_poll_seconds=0.01,
+                )
+                policy = UpgradePolicySpec(
+                    auto_upgrade=True,
+                    max_parallel_upgrades=0,
+                    max_unavailable=IntOrString("100%"),
+                    drain_spec=DrainSpec(
+                        enable=True, force=True, timeout_second=10
+                    ),
+                )
+                for _ in range(40):
+                    state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+                    manager.apply_state(state, policy)
+                    manager.drain_manager.wait_idle(10.0)
+                    manager.pod_manager.wait_idle(10.0)
+                    fleet.reconcile_daemonset()
+                    if set(fleet.states().values()) == {
+                        consts.UPGRADE_STATE_DONE
+                    }:
+                        return
+                raise AssertionError("background rollout did not converge")
+            except Exception as err:  # noqa: BLE001 — surfaced below
+                roll_errors.append(err)
+                # force completion so the watch loop in the MAIN thread
+                # terminates — otherwise a rollout regression would hang
+                # the test until the CI job-level timeout with no message
+                for node in cluster.list("Node"):
+                    cluster.patch(
+                        "Node",
+                        node["metadata"]["name"],
+                        {
+                            "metadata": {
+                                "labels": {
+                                    STATE_KEY_OF(): consts.UPGRADE_STATE_DONE
+                                }
+                            }
+                        },
+                    )
+
+        with ApiServerFacade(cluster) as facade:
+            t = threading.Thread(target=roll, daemon=True)
+            t.start()
+            rc = cli_main(
+                [
+                    "status",
+                    "--kubeconfig",
+                    self._kubeconfig(tmp_path, facade.url),
+                    "--watch",
+                    "--interval",
+                    "0.05",
+                ]
+            )
+            t.join(15.0)
+        out = capsys.readouterr().out
+        assert roll_errors == [], f"background rollout failed: {roll_errors}"
+        assert rc == 0  # returned only once complete
+        assert "done 2/2" in out  # final frame shows completion
+        # (frame COUNT is timing-dependent — a fast rollout may finish
+        # before the first poll, making one frame the correct output)
