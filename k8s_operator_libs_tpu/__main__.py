@@ -37,6 +37,15 @@ from .upgrade.rollout_status import RolloutStatus
 from .upgrade.upgrade_state import ClusterUpgradeStateManager
 
 
+def _positive_float(raw: str) -> float:
+    value = float(raw)
+    if value <= 0:
+        raise argparse.ArgumentTypeError(
+            f"must be > 0 (a 0 interval busy-spins the apiserver), got {raw}"
+        )
+    return value
+
+
 def _parse_selector_arg(selector: str) -> dict:
     labels = {}
     for part in selector.split(","):
@@ -101,42 +110,45 @@ def _open_source(args: argparse.Namespace, cmd: str) -> Tuple[Optional[object], 
 
 def _load_policy_cr(
     args: argparse.Namespace, cluster
-) -> Tuple[Optional[object], int]:
+) -> "Tuple[Optional[object], int, str]":
     """Load + validate the TpuUpgradePolicy CR named by --policy.
-    Returns (policy | None, exit_code); a missing CR is (None, 0) with a
-    note (callers decide whether that is fatal), an invalid CR is fatal."""
+    Returns (policy | None, exit_code, message); a missing CR is
+    (None, 0, note) — callers decide whether that is fatal — an invalid
+    CR is fatal.  The message is RETURNED, not printed: a watch loop
+    re-reads the policy every iteration and must dedup identical
+    errors instead of repeating them for hours."""
     if not args.policy:
-        return None, 0
+        return None, 0, ""
     from .api import UpgradePolicySpec, ValidationError
     from .cluster.errors import ApiError, NotFoundError
 
     try:
         cr = cluster.get("TpuUpgradePolicy", args.policy, args.namespace)
     except NotFoundError:
-        print(
+        return (
+            None,
+            0,
             f"TpuUpgradePolicy {args.namespace}/{args.policy} not found "
             f"in the source",
-            file=sys.stderr,
         )
-        return None, 0
     except (ApiError, OSError) as err:
-        print(
+        return (
+            None,
+            0,
             f"cannot read TpuUpgradePolicy {args.namespace}/"
             f"{args.policy}: {err}",
-            file=sys.stderr,
         )
-        return None, 0
     try:
         policy = UpgradePolicySpec.from_dict(cr.get("spec") or {})
         policy.validate()
     except ValidationError as err:
-        print(
+        return (
+            None,
+            2,
             f"TpuUpgradePolicy {args.namespace}/{args.policy} is "
             f"invalid: {err}",
-            file=sys.stderr,
         )
-        return None, 2
-    return policy, 0
+    return policy, 0, ""
 
 
 def _push_topology_keys(policy) -> None:
@@ -151,16 +163,18 @@ def _push_topology_keys(policy) -> None:
 
 
 def cmd_status(args: argparse.Namespace) -> int:
-    cluster, rc = _open_source(args, "status")
-    if cluster is None:
-        return rc
     if args.watch and args.state_file:
+        # before _open_source: rejecting after parsing a multi-MB dump
+        # wastes the whole read (and repair orders its guard this way)
         print(
             "--watch needs a live source (--kubeconfig/--in-cluster); "
             "a state-file dump never changes",
             file=sys.stderr,
         )
         return 2
+    cluster, rc = _open_source(args, "status")
+    if cluster is None:
+        return rc
     util.set_component_name(args.component)
     from .cluster.errors import ApiError
     from .upgrade.upgrade_state import UpgradeStateError
@@ -168,6 +182,7 @@ def cmd_status(args: argparse.Namespace) -> int:
     manager = ClusterUpgradeStateManager(cluster)
     policy = None
     gates_noted = False
+    last_policy_msg = None
     last_rendered = None
     while True:
         try:
@@ -195,12 +210,16 @@ def cmd_status(args: argparse.Namespace) -> int:
         # agree) and a transient read failure must not permanently
         # disable gate evaluation; a failed read keeps the last good
         # policy, mirroring CrPolicySource.
-        loaded, prc = _load_policy_cr(args, cluster)
+        loaded, prc, pmsg = _load_policy_cr(args, cluster)
+        if pmsg and pmsg != last_policy_msg:
+            print(pmsg, file=sys.stderr)
+            last_policy_msg = pmsg
         if prc:
             if not args.watch:
                 return prc
         elif loaded is not None:
             policy = loaded
+            last_policy_msg = ""
         if args.policy and policy is None and not gates_noted:
             print("gates not evaluated", file=sys.stderr)
             gates_noted = True
@@ -232,7 +251,9 @@ def cmd_plan(args: argparse.Namespace) -> int:
     from .upgrade.plan import plan_rollout
     from .upgrade.upgrade_state import UpgradeStateError
 
-    policy, rc = _load_policy_cr(args, cluster)
+    policy, rc, pmsg = _load_policy_cr(args, cluster)
+    if pmsg:
+        print(pmsg, file=sys.stderr)
     if rc:
         return rc
     if args.policy and policy is None:
@@ -338,6 +359,99 @@ def cmd_history(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_repair(args: argparse.Namespace) -> int:
+    """Codify the upgrade-failed runbook: delete a failed node's driver
+    pod so the DaemonSet recreates it at the target revision and the
+    state machine self-heals the node to done (common_manager's
+    failed-recovery processor).  Dry-run by default; ``--yes`` applies."""
+    if args.state_file:
+        print(
+            "repair writes to the cluster: it needs a live source "
+            "(--kubeconfig/--in-cluster), not a dump",
+            file=sys.stderr,
+        )
+        return 2
+    cluster, rc = _open_source(args, "repair")
+    if cluster is None:
+        return rc
+    util.set_component_name(args.component)
+    from .cluster.errors import ApiError
+    from .upgrade import consts as upgrade_consts
+
+    state_key = util.get_upgrade_state_label_key()
+    selector = args.selector
+    try:
+        nodes = cluster.list("Node")
+        failed = [
+            n["metadata"]["name"]
+            for n in nodes
+            if (n["metadata"].get("labels") or {}).get(state_key)
+            == upgrade_consts.UPGRADE_STATE_FAILED
+            and (not args.node or n["metadata"]["name"] == args.node)
+        ]
+        driver_pods = cluster.list(
+            "Pod", namespace=args.namespace, label_selector=selector
+        )
+        plan = [
+            (name, pod["metadata"]["name"], args.namespace)
+            for name in failed
+            for pod in driver_pods
+            if (pod.get("spec") or {}).get("nodeName") == name
+        ]
+    except (ApiError, OSError) as err:
+        print(f"cannot read cluster state: {err}", file=sys.stderr)
+        return 2
+    if args.node and not failed:
+        print(
+            f"node {args.node} is not in upgrade-failed; nothing to repair",
+            file=sys.stderr,
+        )
+        return 3
+    if args.json:
+        print(
+            json.dumps(
+                [
+                    {"node": n, "pod": p, "namespace": ns}
+                    for n, p, ns in plan
+                ]
+            )
+        )
+    elif not plan:
+        print("no failed nodes with driver pods found; nothing to repair")
+    else:
+        for node, pod, ns in plan:
+            print(
+                f"{node}: delete driver pod {ns}/{pod} (DS recreates at target)"
+            )
+    if not plan:
+        return 0
+    if not args.yes:
+        if not args.json:
+            print(
+                f"dry run — would repair {len(plan)} pod(s); re-run with "
+                "--yes to apply",
+            )
+        return 0
+    errors = 0
+    from .cluster.errors import NotFoundError
+
+    for node, pod, ns in plan:
+        try:
+            cluster.delete("Pod", pod, ns)
+        except NotFoundError:
+            continue  # already gone — the DS beat us to it
+        except (ApiError, OSError) as err:
+            print(f"failed to delete {ns}/{pod}: {err}", file=sys.stderr)
+            errors += 1
+    if not args.json:
+        print(
+            f"repaired {len(plan) - errors}/{len(plan)} pod(s); failed "
+            "nodes self-heal once their pods return in sync at the "
+            "target revision"
+        )
+    return 0 if errors == 0 else 1
+
+
 def _add_source_args(sp: argparse.ArgumentParser) -> None:
     """How to OPEN the cluster (shared by every read-only subcommand)."""
     sp.add_argument(
@@ -403,9 +517,9 @@ def main(argv=None) -> int:
     )
     st.add_argument(
         "--interval",
-        type=float,
+        type=_positive_float,
         default=2.0,
-        help="poll interval for --watch (seconds)",
+        help="poll interval for --watch (seconds, > 0)",
     )
     st.set_defaults(func=cmd_status)
 
@@ -477,6 +591,21 @@ def main(argv=None) -> int:
         "the pure upgrade timeline (default: all components)",
     )
     hi.set_defaults(func=cmd_history)
+
+    rp = sub.add_parser(
+        "repair",
+        help="replace the driver pods of upgrade-failed nodes so they "
+        "self-heal (the documented runbook step; dry-run unless --yes)",
+    )
+    _add_source_args(rp)
+    _add_query_args(rp)
+    rp.add_argument("--node", default="", help="repair only this node")
+    rp.add_argument(
+        "--yes",
+        action="store_true",
+        help="actually delete the pods (default: dry-run listing)",
+    )
+    rp.set_defaults(func=cmd_repair)
 
     args = parser.parse_args(argv)
     try:

@@ -709,3 +709,133 @@ class TestStatusWatchMode:
         assert "done 2/2" in out  # final frame shows completion
         # (frame COUNT is timing-dependent — a fast rollout may finish
         # before the first poll, making one frame the correct output)
+
+
+class TestRepairCli:
+    """`repair`: the upgrade-failed runbook (replace the driver pod so
+    the node self-heals) as a CLI — dry-run by default, writes need
+    --yes, dumps are rejected (it mutates the cluster)."""
+
+    def _kubeconfig(self, tmp_path, url):
+        kc = tmp_path / "kubeconfig"
+        kc.write_text(
+            "\n".join(
+                [
+                    "apiVersion: v1",
+                    "kind: Config",
+                    "current-context: t",
+                    "contexts:",
+                    "- name: t",
+                    "  context: {cluster: t, user: t}",
+                    "clusters:",
+                    f"- name: t\n  cluster: {{server: {url}}}",
+                    "users:",
+                    "- name: t\n  user: {token: x}",
+                ]
+            )
+        )
+        return str(kc)
+
+    def _failed_fleet(self, cluster):
+        fleet = Fleet(cluster)
+        fleet.add_node("good", pod_hash="rev2")
+        fleet.add_node("sick", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        cluster.patch(
+            "Node",
+            "good",
+            {"metadata": {"labels": {STATE_KEY_OF(): consts.UPGRADE_STATE_DONE}}},
+        )
+        cluster.patch(
+            "Node",
+            "sick",
+            {
+                "metadata": {
+                    "labels": {STATE_KEY_OF(): consts.UPGRADE_STATE_FAILED}
+                }
+            },
+        )
+        return fleet
+
+    def test_rejects_state_file(self, cluster, tmp_path, capsys):
+        dump = tmp_path / "d.json"
+        dump.write_text(json.dumps(cluster.to_dict()))
+        rc = cli_main(["repair", "--state-file", str(dump)])
+        assert rc == 2
+        assert "live source" in capsys.readouterr().err
+
+    def test_dry_run_lists_without_deleting(self, cluster, tmp_path, capsys):
+        from k8s_operator_libs_tpu.cluster import ApiServerFacade
+
+        self._failed_fleet(cluster)
+        pods_before = len(cluster.list("Pod", namespace=NAMESPACE))
+        with ApiServerFacade(cluster) as facade:
+            rc = cli_main(
+                ["repair", "--kubeconfig", self._kubeconfig(tmp_path, facade.url)]
+            )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "sick" in out and "dry run" in out
+        assert "good" not in out.split("dry run")[0]  # only failed nodes
+        assert len(cluster.list("Pod", namespace=NAMESPACE)) == pods_before
+
+    def test_yes_deletes_and_node_self_heals(self, cluster, tmp_path, capsys):
+        from k8s_operator_libs_tpu.api import (
+            DrainSpec,
+            IntOrString,
+            UpgradePolicySpec,
+        )
+        from k8s_operator_libs_tpu.cluster import ApiServerFacade
+        from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+            ClusterUpgradeStateManager,
+        )
+
+        fleet = self._failed_fleet(cluster)
+        with ApiServerFacade(cluster) as facade:
+            rc = cli_main(
+                [
+                    "repair",
+                    "--kubeconfig",
+                    self._kubeconfig(tmp_path, facade.url),
+                    "--yes",
+                ]
+            )
+        assert rc == 0
+        assert "repaired 1/1" in capsys.readouterr().out
+        # DS recreates the pod at the target revision; the state machine
+        # self-heals the failed node (failed-recovery processor)
+        fleet.reconcile_daemonset()
+        manager = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        )
+        for _ in range(20):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10.0)
+            manager.pod_manager.wait_idle(10.0)
+            fleet.reconcile_daemonset()
+            if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
+                break
+        assert set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}
+
+    def test_node_filter_and_not_failed_exit(self, cluster, tmp_path, capsys):
+        from k8s_operator_libs_tpu.cluster import ApiServerFacade
+
+        self._failed_fleet(cluster)
+        with ApiServerFacade(cluster) as facade:
+            kc = self._kubeconfig(tmp_path, facade.url)
+            rc = cli_main(["repair", "--kubeconfig", kc, "--node", "good"])
+            assert rc == 3
+            assert "not in upgrade-failed" in capsys.readouterr().err
+            rc = cli_main(
+                ["repair", "--kubeconfig", kc, "--node", "sick", "--json"]
+            )
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert len(data) == 1 and data[0]["node"] == "sick"
