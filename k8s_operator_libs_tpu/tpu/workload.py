@@ -361,7 +361,10 @@ def pipeline_blocks_apply(
     ``jax.grad`` yields the pipelined backward pass for free.
 
     Demo scope: one block per stage (``n_layers == n_stages``)."""
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
 
     block = Block(config)
     n_stages = mesh.shape["stage"]
@@ -407,13 +410,16 @@ def pipeline_blocks_apply(
             return (nxt, outs), None
 
         # scan carries must be stage-VARYING from tick 0 (they hold
-        # per-stage activations after the first ppermute); pvary marks
-        # the zero-init accordingly or the cond/scan types mismatch
+        # per-stage activations after the first ppermute) or the
+        # cond/scan types mismatch
+        def mark_varying(x):
+            if hasattr(jax.lax, "pcast"):  # jax >= 0.8
+                return jax.lax.pcast(x, ("stage",), to="varying")
+            return jax.lax.pvary(x, ("stage",))  # pragma: no cover
+
         init = (
-            jax.lax.pvary(
-                jnp.zeros(micro_in.shape[1:], micro_in.dtype), ("stage",)
-            ),
-            jax.lax.pvary(jnp.zeros_like(micro_in), ("stage",)),
+            mark_varying(jnp.zeros(micro_in.shape[1:], micro_in.dtype)),
+            mark_varying(jnp.zeros_like(micro_in)),
         )
         (_, outs), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
         # keep a leading stage dim so the out_spec can place it; only the
