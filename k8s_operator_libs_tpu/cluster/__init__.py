@@ -13,6 +13,7 @@ from .errors import (
     BadRequestError,
     ConflictError,
     ExpiredError,
+    InvalidError,
     NotFoundError,
     TooManyRequestsError,
     UnauthorizedError,
@@ -27,7 +28,7 @@ from .execauth import (
     ExecCredentialPlugin,
     ExecPluginSpec,
 )
-from .inmem import InMemoryCluster, WatchEvent, merge_patch
+from .inmem import InMemoryCluster, ListPage, WatchEvent, merge_patch
 from .strategicmerge import register_merge_key, strategic_merge
 from .kubeclient import KubeApiClient, KubeConfig, KubeConfigError
 from .retry import retry_on_conflict
@@ -45,6 +46,7 @@ __all__ = [
     "KubeConfigError",
     "InformerCache",
     "InMemoryCluster",
+    "ListPage",
     "WatchEvent",
     "merge_patch",
     "register_merge_key",
@@ -56,6 +58,7 @@ __all__ = [
     "labels_to_selector",
     "ApiError",
     "ExpiredError",
+    "InvalidError",
     "NotFoundError",
     "ConflictError",
     "AlreadyExistsError",

@@ -54,6 +54,7 @@ from .errors import (
     BadRequestError,
     ConflictError,
     ExpiredError,
+    InvalidError,
     NotFoundError,
     TooManyRequestsError,
     UnauthorizedError,
@@ -69,6 +70,7 @@ _REASONS = {
     ConflictError: "Conflict",
     BadRequestError: "BadRequest",
     ExpiredError: "Gone",
+    InvalidError: "Invalid",
     TooManyRequestsError: "TooManyRequests",
 }
 
@@ -104,6 +106,8 @@ class _Handler(BaseHTTPRequestHandler):
     #: credential refresh-on-401 path).  Shared mutable set — the facade
     #: owns it.
     accepted_tokens: Optional[set] = None
+    #: >0 = server-enforced LIST page cap (see ApiServerFacade).
+    max_list_page: int = 0
 
     def _check_auth(self) -> None:
         if self.accepted_tokens is None:
@@ -202,19 +206,39 @@ class _Handler(BaseHTTPRequestHandler):
         if query.get("watch") in ("true", "1"):
             self._serve_watch(info, query)
             return
-        items = self.cluster.list(
+        # Chunked LIST: client limit capped by the server-side max page
+        # size (when the facade enforces one, EVERY response paginates —
+        # the contract tests run rollouts with max_list_page=500 so the
+        # client's pager is on the hot path, not an optional nicety).
+        try:
+            limit = int(query.get("limit") or 0)
+        except ValueError as err:
+            raise BadRequestError("limit must be an integer") from err
+        max_page = getattr(self, "max_list_page", 0)
+        if max_page:
+            limit = min(limit, max_page) if limit else max_page
+        page = self.cluster.list_page(
             info.kind,
             namespace=namespace if info.namespaced and namespace else None,
             label_selector=query.get("labelSelector", ""),
             field_selector=query.get("fieldSelector", ""),
+            limit=limit,
+            continue_token=query.get("continue", ""),
+            resource_version=query.get("resourceVersion", ""),
+            resource_version_match=query.get("resourceVersionMatch", ""),
         )
+        meta: JsonObj = {"resourceVersion": page.resource_version}
+        if page.continue_token:
+            meta["continue"] = page.continue_token
+        if page.remaining_item_count is not None:
+            meta["remainingItemCount"] = page.remaining_item_count
         body = {
             "kind": f"{info.kind}List",
             "apiVersion": (
                 f"{info.group}/{info.version}" if info.group else info.version
             ),
-            "metadata": {"resourceVersion": str(self.cluster.journal_seq())},
-            "items": [_with_gvk(o, info) for o in items],
+            "metadata": meta,
+            "items": [_with_gvk(o, info) for o in page.items],
         }
         self._send_json(200, body)
 
@@ -445,6 +469,7 @@ class ApiServerFacade:
         cluster: InMemoryCluster,
         port: int = 0,
         accepted_tokens: Optional[set] = None,
+        max_list_page: int = 0,
     ) -> None:
         self.cluster = cluster
         #: Mutable: tests rotate the accepted set mid-run to force 401s
@@ -453,7 +478,15 @@ class ApiServerFacade:
         self._handler_cls = type(
             "BoundHandler",
             (_Handler,),
-            {"cluster": cluster, "accepted_tokens": accepted_tokens},
+            {
+                "cluster": cluster,
+                "accepted_tokens": accepted_tokens,
+                # >0: server-enforced page cap — every LIST paginates at
+                # most this many items per response, client limit or not
+                # (how the contract tests force the pager onto every
+                # code path).
+                "max_list_page": max_list_page,
+            },
         )
         self._server = ThreadingHTTPServer(("127.0.0.1", port), self._handler_cls)
         self._server.daemon_threads = True

@@ -31,19 +31,23 @@ client-go's cache-copy discipline).
 from __future__ import annotations
 
 import copy
+import secrets
 import threading
 import time
 import uuid
-from typing import Any, Callable, Dict, List, Optional
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .errors import (
     AlreadyExistsError,
     BadRequestError,
     ConflictError,
     ExpiredError,
+    InvalidError,
     NotFoundError,
     TooManyRequestsError,
 )
+from . import schema as crschema
 from .client import JsonObj, Key  # canonical aliases (re-exported here)
 from .selectors import match_label_selector, parse_selector
 
@@ -87,6 +91,40 @@ def merge_patch(target: JsonObj, patch: JsonObj) -> JsonObj:
         else:
             out[k] = json_copy(v)
     return out
+
+
+@dataclass
+class ListPage:
+    """One page of a chunked LIST (the ``limit``/``continue`` protocol).
+
+    *resource_version* is the SNAPSHOT revision: every page of one
+    paginated list reports the same value — the collection revision the
+    first page was cut at — exactly as a real apiserver serves continue
+    pages from the etcd snapshot the token pins (client-go pager
+    contract; reference inherits it via go.mod:11-16)."""
+
+    items: List[JsonObj]
+    continue_token: str  # "" = last page
+    resource_version: str
+    remaining_item_count: Optional[int] = None
+
+
+@dataclass
+class _PageSnapshot:
+    """Server-side state behind a continue token family.
+
+    The full matching result set is snapshotted (deep copies) when the
+    first ``limit=N`` page is cut; later pages slice it.  Tokens are
+    ``<handle>.<offset>`` so a network-level retry of the SAME token
+    serves the SAME page (idempotent reads — client-go retries a page
+    before falling back to a full relist)."""
+
+    rv: int  # collection revision the snapshot was cut at
+    #: The collection the snapshot was cut from — a token replayed
+    #: against a different kind/namespace/selector is a 400, exactly
+    #: like a real apiserver's token/request mismatch rejection.
+    request: Tuple[str, Optional[str], str, str] = ("", None, "", "")
+    items: List[JsonObj] = field(default_factory=list)
 
 
 class WatchEvent:
@@ -137,6 +175,20 @@ class InMemoryCluster:
         #: Bench A/B toggle: False forces every list into a full-store
         #: scan (the round-1 behavior) so the index win is measurable.
         self._use_indexes = use_indexes
+        # Chunked-LIST continue-token table: handle -> snapshot.  Tokens
+        # expire (410 Gone) when the collection revision has advanced
+        # past the journal retention window — the compaction analog —
+        # or when the table is full and the handle is evicted (FIFO by
+        # creation order; drained snapshots are deleted eagerly).
+        self._page_snapshots: Dict[str, _PageSnapshot] = {}
+        self._page_snapshot_cap = 64
+        # Admission schemas: CR kind -> openAPIV3Schema, registered when
+        # a CustomResourceDefinition carrying a structural schema is
+        # applied (exactly envtest: load the CRD, get real validation).
+        # Kinds with no applied CRD stay schemaless — the pre-round-4
+        # behavior, so plain unit tests that never apply CRDs are
+        # untouched.
+        self._crd_schemas: Dict[str, JsonObj] = {}
 
     # ------------------------------------------------------------------ util
     def _next_rv(self) -> str:
@@ -176,6 +228,43 @@ class InMemoryCluster:
             del self._journal[:evicted]
         self._journal_cond.notify_all()
 
+    # -------------------------------------------------------------- admission
+    def _admit(self, obj: JsonObj) -> None:
+        """Structural-schema admission (envtest behavior): apply the
+        schema's defaults to absent fields, then validate — 422
+        :class:`InvalidError` on violation, so an invalid CR never
+        reaches a controller.  No-op for kinds without an applied CRD
+        schema."""
+        schema = self._crd_schemas.get(obj.get("kind") or "")
+        if schema is None:
+            return
+        crschema.apply_defaults(obj, schema)
+        violations = crschema.validate(obj, schema)
+        if violations:
+            meta = obj.get("metadata") or {}
+            raise InvalidError(
+                f"{obj.get('kind')} "
+                f"{meta.get('namespace', '')}/{meta.get('name', '')} "
+                f"is invalid: " + "; ".join(violations)
+            )
+
+    def _register_crd_schema(self, crd: JsonObj) -> None:
+        """Track the CRD's CURRENT schema: registering a schemaless
+        version of a previously-schemaed CRD unregisters it (a real
+        apiserver stops validating the moment the structural schema is
+        removed)."""
+        extracted = crschema.extract_crd_schema(crd)
+        if extracted is not None:
+            kind, schema_ = extracted
+            self._crd_schemas[kind] = json_copy(schema_)
+        else:
+            self._unregister_crd_schema(crd)
+
+    def _unregister_crd_schema(self, crd: JsonObj) -> None:
+        kind = (((crd.get("spec") or {}).get("names") or {}).get("kind")) or ""
+        if kind:
+            self._crd_schemas.pop(kind, None)
+
     # ------------------------------------------------------------------ CRUD
     def create(self, obj: JsonObj) -> JsonObj:
         with self._lock:
@@ -183,6 +272,10 @@ class InMemoryCluster:
             if key in self._store:
                 raise AlreadyExistsError(f"{key} already exists")
             stored = json_copy(obj)
+            if stored.get("kind") == "CustomResourceDefinition":
+                self._register_crd_schema(stored)
+            else:
+                self._admit(stored)
             meta = stored.setdefault("metadata", {})
             meta["resourceVersion"] = self._next_rv()
             meta.setdefault("uid", str(uuid.uuid4()))
@@ -242,52 +335,234 @@ class InMemoryCluster:
         O(store)).  ``field_filter`` is an arbitrary predicate run on the
         stored objects BEFORE copying (test/simulation convenience; a real
         client would filter after the fact)."""
-        match = parse_selector(label_selector)
         with self._lock:
-            # Candidates come from the narrowest available index; label /
-            # field filters then run on the stored objects FIRST, so only
-            # matches are copied (copying under the store lock is what
-            # serializes concurrent readers at fleet scale).
-            node_filter = None
-            if field_selector:
-                if kind != "Pod" or not field_selector.startswith(
-                    "spec.nodeName="
-                ):
-                    raise BadRequestError(
-                        f"unsupported field selector {field_selector!r} "
-                        f"for kind {kind} (only Pod spec.nodeName=... is "
-                        f"indexed)"
-                    )
-                node = field_selector.split("=", 1)[1]
-                if self._use_indexes:
-                    keys = self._pods_by_node.get(node) or ()
-                else:
-                    node_filter = node
-                    keys = [k for k in self._store if k[0] == kind]
-            elif self._use_indexes:
-                keys = self._by_kind.get(kind) or ()
-            else:
-                keys = [k for k in self._store if k[0] == kind]
-            matches = []
-            for key in keys:
-                obj = self._store.get(key)
-                if obj is None:
-                    continue
-                _, ns, _name = key
-                if namespace is not None and ns != namespace:
-                    continue
-                if node_filter is not None and (
-                    (obj.get("spec") or {}).get("nodeName") or ""
-                ) != node_filter:
-                    continue
-                labels = (obj.get("metadata") or {}).get("labels") or {}
-                if not match(labels):
-                    continue
-                if field_filter is not None and not field_filter(obj):
-                    continue
-                matches.append((key, obj))
-            matches.sort(key=lambda kv: kv[0])
+            matches = self._scan(
+                kind, namespace, label_selector, field_filter, field_selector
+            )
             return [json_copy(obj) for _, obj in matches]
+
+    def _scan(
+        self,
+        kind: str,
+        namespace: Optional[str],
+        label_selector: str,
+        field_filter: Optional[Callable[[JsonObj], bool]],
+        field_selector: str,
+    ) -> List[Tuple[Key, JsonObj]]:
+        """Sorted (key, stored-object) matches — caller holds the lock
+        and copies.  Candidates come from the narrowest available index;
+        label / field filters run on the stored objects FIRST, so only
+        matches are copied (copying under the store lock is what
+        serializes concurrent readers at fleet scale)."""
+        match = parse_selector(label_selector)
+        node_filter = None
+        if field_selector:
+            if kind != "Pod" or not field_selector.startswith(
+                "spec.nodeName="
+            ):
+                raise BadRequestError(
+                    f"unsupported field selector {field_selector!r} "
+                    f"for kind {kind} (only Pod spec.nodeName=... is "
+                    f"indexed)"
+                )
+            node = field_selector.split("=", 1)[1]
+            if self._use_indexes:
+                keys = self._pods_by_node.get(node) or ()
+            else:
+                node_filter = node
+                keys = [k for k in self._store if k[0] == kind]
+        elif self._use_indexes:
+            keys = self._by_kind.get(kind) or ()
+        else:
+            keys = [k for k in self._store if k[0] == kind]
+        matches = []
+        for key in keys:
+            obj = self._store.get(key)
+            if obj is None:
+                continue
+            _, ns, _name = key
+            if namespace is not None and ns != namespace:
+                continue
+            if node_filter is not None and (
+                (obj.get("spec") or {}).get("nodeName") or ""
+            ) != node_filter:
+                continue
+            labels = (obj.get("metadata") or {}).get("labels") or {}
+            if not match(labels):
+                continue
+            if field_filter is not None and not field_filter(obj):
+                continue
+            matches.append((key, obj))
+        matches.sort(key=lambda kv: kv[0])
+        return matches
+
+    def list_page(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: str = "",
+        field_selector: str = "",
+        limit: int = 0,
+        continue_token: str = "",
+        resource_version: str = "",
+        resource_version_match: str = "",
+    ) -> ListPage:
+        """Chunked LIST — the ``limit``/``continue`` protocol a real
+        apiserver speaks (client-go pager; the reference inherits it via
+        controller-runtime's paginated cache fills, go.mod:11-16).
+
+        * ``limit=N`` cuts the sorted result set into pages of N; the
+          FULL matching set is snapshotted server-side so later pages
+          are consistent at the first page's collection revision, no
+          matter what writes land between pages (etcd-MVCC analog).
+        * ``continue_token`` resumes a snapshot.  Tokens are idempotent
+          (re-requesting the same token re-serves the same page) and
+          expire with :class:`ExpiredError` (410 Gone) once the
+          collection revision has advanced past the journal retention
+          window — the compaction analog — or the snapshot was evicted.
+        * ``resource_version`` + ``resource_version_match``: ``Exact``
+          requires the requested revision to still be current (else 410,
+          matching a compacted revision); ``NotOlderThan`` serves the
+          latest state provided it is >= the requested revision; a
+          FUTURE revision is a :class:`BadRequestError` (the apiserver's
+          "too large resource version" rejection).
+        """
+        if limit < 0:
+            raise BadRequestError("limit must be >= 0")
+        if resource_version_match and resource_version_match not in (
+            "Exact",
+            "NotOlderThan",
+        ):
+            raise BadRequestError(
+                f"invalid resourceVersionMatch {resource_version_match!r} "
+                f"(want Exact or NotOlderThan)"
+            )
+        if resource_version_match and not resource_version:
+            raise BadRequestError(
+                "resourceVersionMatch requires resourceVersion"
+            )
+        if resource_version == "0" and resource_version_match == "Exact":
+            raise BadRequestError(
+                'resourceVersionMatch "Exact" is forbidden for '
+                'resourceVersion "0"'
+            )
+        request = (kind, namespace, label_selector, field_selector)
+        with self._lock:
+            if continue_token:
+                if resource_version:
+                    raise BadRequestError(
+                        "resourceVersion is not allowed with continue"
+                    )
+                return self._serve_continue(continue_token, limit, request)
+            current = self._rv
+            if resource_version and resource_version != "0":
+                try:
+                    requested = int(resource_version)
+                except ValueError as err:
+                    raise BadRequestError(
+                        f"invalid resourceVersion {resource_version!r}"
+                    ) from err
+                if requested > current:
+                    raise BadRequestError(
+                        f"resourceVersion {requested} is in the future "
+                        f"(current {current})"
+                    )
+                if (
+                    resource_version_match == "Exact"
+                    and requested != current
+                ):
+                    raise ExpiredError(
+                        f"resourceVersion {requested} no longer available "
+                        f"(compacted; current {current})"
+                    )
+                # NotOlderThan (or unset): latest always qualifies.
+            matches = self._scan(
+                kind, namespace, label_selector, None, field_selector
+            )
+            items = [json_copy(obj) for _, obj in matches]
+            if not limit or len(items) <= limit:
+                return ListPage(items, "", str(current))
+            # The first page is handed out directly; the REMAINDER is
+            # retained server-side (private copies — nothing else holds
+            # these) so later pages are consistent at this revision.
+            handle = secrets.token_hex(8)
+            self._page_snapshots[handle] = _PageSnapshot(
+                rv=current, request=request, items=items[limit:]
+            )
+            while len(self._page_snapshots) > self._page_snapshot_cap:
+                evict = next(iter(self._page_snapshots))
+                del self._page_snapshots[evict]
+            # A real apiserver omits remainingItemCount on selector-
+            # filtered lists (it cannot compute it cheaply from etcd);
+            # mirroring that keeps facade-developed clients honest.
+            return ListPage(
+                items[:limit],
+                f"{handle}.0",
+                str(current),
+                remaining_item_count=(
+                    None
+                    if label_selector or field_selector
+                    else len(items) - limit
+                ),
+            )
+
+    def _serve_continue(
+        self,
+        token: str,
+        limit: int,
+        request: Tuple[str, Optional[str], str, str],
+    ) -> ListPage:
+        handle, _, offset_s = token.partition(".")
+        snap = self._page_snapshots.get(handle)
+        try:
+            offset = int(offset_s)
+        except ValueError as err:
+            raise ExpiredError(f"malformed continue token {token!r}") from err
+        if snap is None or offset < 0:
+            raise ExpiredError(
+                "continue token expired or malformed — relist"
+            )
+        # LRU touch: an actively-draining pagination must outlive
+        # abandoned single-page snapshots when the table overflows
+        # (eviction pops from the front; re-inserting moves us to the
+        # back).
+        self._page_snapshots[handle] = self._page_snapshots.pop(handle)
+        if snap.request != request:
+            raise BadRequestError(
+                f"continue token was issued for {snap.request}, not "
+                f"{request} — a token only resumes the list it came from"
+            )
+        # Compaction analog: the journal has rolled past the snapshot's
+        # revision, so a real server could no longer serve it.
+        if snap.rv < self._journal_floor:
+            del self._page_snapshots[handle]
+            raise ExpiredError(
+                f"continue token at revision {snap.rv} predates retention "
+                f"floor {self._journal_floor} — relist"
+            )
+        remaining = len(snap.items) - offset
+        if not limit:
+            limit = max(remaining, 1)
+        chunk = snap.items[offset : offset + limit]
+        next_off = offset + limit
+        done = next_off >= len(snap.items)
+        if done:
+            # Drained: drop the retained remainder eagerly.  This makes
+            # the final page non-replayable (it 410s → client relists),
+            # which is safe; holding 64 near-full collection copies for
+            # replayability is not.
+            del self._page_snapshots[handle]
+        _, _, label_selector, field_selector = request
+        return ListPage(
+            [json_copy(o) for o in chunk],
+            "" if done else f"{handle}.{next_off}",
+            str(snap.rv),
+            remaining_item_count=(
+                None
+                if done or label_selector or field_selector
+                else len(snap.items) - next_off
+            ),
+        )
 
     def update(self, obj: JsonObj) -> JsonObj:
         """Full-object replace with optimistic concurrency on resourceVersion."""
@@ -303,6 +578,10 @@ class InMemoryCluster:
                 )
             old = json_copy(current)
             stored = json_copy(obj)
+            if stored.get("kind") == "CustomResourceDefinition":
+                self._register_crd_schema(stored)
+            else:
+                self._admit(stored)
             stored["metadata"]["uid"] = current["metadata"]["uid"]
             stored["metadata"]["creationTimestamp"] = current["metadata"][
                 "creationTimestamp"
@@ -368,6 +647,10 @@ class InMemoryCluster:
                 merged = merge_patch(current, patch_body)
             # kind / name / namespace / uid are immutable, like a real apiserver
             merged["kind"] = kind
+            if kind == "CustomResourceDefinition":
+                self._register_crd_schema(merged)
+            else:
+                self._admit(merged)
             merged["metadata"]["uid"] = current["metadata"]["uid"]
             merged["metadata"]["name"] = name
             if namespace:
@@ -452,6 +735,8 @@ class InMemoryCluster:
                     self._record("Modified", old, json_copy(obj))
                 return
             self._store_pop(key)
+            if kind == "CustomResourceDefinition":
+                self._unregister_crd_schema(obj)
             self._next_rv()  # deletions advance the version sequence too
             self._record("Deleted", json_copy(obj), None)
 
@@ -661,6 +946,8 @@ class InMemoryCluster:
             for obj in data.get("objects", []):
                 key = _key_of(obj)
                 cluster._store_put(key, json_copy(obj))
+                if obj.get("kind") == "CustomResourceDefinition":
+                    cluster._register_crd_schema(obj)
         for obj in data.get("objects", []):
             if obj.get("kind") == "CustomResourceDefinition":
                 conds = (obj.get("status") or {}).get("conditions") or []

@@ -83,6 +83,7 @@ from .errors import (
     BadRequestError,
     ConflictError,
     ExpiredError,
+    InvalidError,
     NotFoundError,
     TooManyRequestsError,
     UnauthorizedError,
@@ -348,6 +349,12 @@ class KubeApiClient:
         #: streams configure their own longer hold via
         #: start_held_watches(hold_seconds=...).
         self.watch_timeout_seconds = 1
+        #: Chunked-LIST page size (client-go pager default 500).  Every
+        #: list() asks for at most this many items per response and
+        #: follows ``metadata.continue`` until the collection is drained;
+        #: 0 disables client-side chunking (the server may still
+        #: paginate — the pager loop always honors continue tokens).
+        self.list_page_size = 500
 
     # ------------------------------------------------------------ transport
     def _build_ssl_context(
@@ -533,6 +540,8 @@ class KubeApiClient:
             return ExpiredError(message)
         if code == 429 or reason == "TooManyRequests":
             return TooManyRequestsError(message)
+        if code == 422 or reason == "Invalid":
+            return InvalidError(message)
         if code == 400 or reason == "BadRequest":
             return BadRequestError(message)
         return ApiError(message)
@@ -563,21 +572,52 @@ class KubeApiClient:
         field_selector: str = "",
     ) -> List[JsonObj]:
         info = kind_info(kind)
-        query: Dict[str, str] = {}
+        base_query: Dict[str, str] = {}
         if label_selector:
-            query["labelSelector"] = label_selector
+            base_query["labelSelector"] = label_selector
         if field_selector:
-            query["fieldSelector"] = field_selector
+            base_query["fieldSelector"] = field_selector
+        if self.list_page_size:
+            base_query["limit"] = str(self.list_page_size)
         path = info.path(namespace=namespace or "")
-        _, body = self._request("GET", path, query=query or None)
+        # Chunked-LIST pager (client-go pager semantics): follow
+        # ``metadata.continue`` until the collection is drained.  A 410
+        # mid-pagination means the server compacted the snapshot the
+        # token pins — restart the whole list once from scratch (the
+        # pager's full-relist fallback); pages before the restart are
+        # discarded, never mixed across snapshots.
+        first_body: JsonObj = {}
+        items: List[JsonObj] = []
+        for attempt in (0, 1):
+            query = dict(base_query)
+            items = []
+            try:
+                while True:
+                    _, body = self._request(
+                        "GET", path, query=query or None
+                    )
+                    if not items:
+                        first_body = body
+                    items.extend(body.get("items") or [])
+                    token = (body.get("metadata") or {}).get("continue")
+                    if not token:
+                        break
+                    query = dict(base_query)
+                    query["continue"] = token
+                break
+            except ExpiredError:
+                if attempt:
+                    raise
+                metrics.record_list_pagination_restart()
         # The collection RV is a valid watch start for THIS kind (the
         # informer list-then-watch contract) — it SEEDS the kind's
         # bookmark so watches never borrow another kind's RV.  Seed only:
         # later lists (managers relist constantly) must never advance the
         # watch position past frames the watcher hasn't consumed — only
-        # delivered frames and server BOOKMARK events do that.
-        self._seed_bookmark(kind, body)
-        items = body.get("items") or []
+        # delivered frames and server BOOKMARK events do that.  With
+        # pagination every page reports the SNAPSHOT revision, so the
+        # first page's RV is the right (and identical) seed.
+        self._seed_bookmark(kind, first_body)
         out = []
         for item in items:
             item.setdefault("kind", kind)
@@ -723,10 +763,19 @@ class KubeApiClient:
     def journal_seq(self) -> int:
         """Highest resourceVersion currently visible (a list's
         ``metadata.resourceVersion`` — the standard informer bookmark).
-        ``limit=1`` keeps the transfer to one item: the list RV reflects
-        the whole collection's revision regardless of page size."""
+        The match-nothing label selector keeps the response to ZERO
+        items (the collection RV reflects the whole collection's
+        revision regardless of the selector) — and, since nothing
+        paginates, a page-capped server never cuts a continue snapshot
+        for a probe that will not continue it (wait_for_seq polls this
+        every 50 ms; orphan snapshots would churn the server's token
+        table)."""
         info = kind_info("Node")
-        _, body = self._request("GET", info.path(), query={"limit": "1"})
+        _, body = self._request(
+            "GET",
+            info.path(),
+            query={"labelSelector": "k8s-operator-libs-tpu/rv-probe=none"},
+        )
         # This IS a Node list — its RV seeds the Node watch bookmark at
         # cursor time (first-touch only, like every list).
         return self._seed_bookmark("Node", body)

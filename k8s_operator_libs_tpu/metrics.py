@@ -405,6 +405,29 @@ def record_watch_expired(kind: str) -> None:
     ).inc(kind)
 
 
+def record_atomic_list_patch(kind: str, path: str) -> None:
+    """A strategic-merge patch touched a list field with no registered
+    merge key, so it merged ATOMICALLY (whole-list replace).  A real
+    apiserver keyed-merges any list its struct tags cover — if the
+    patched field is one of those, register the key with
+    :func:`~.cluster.strategicmerge.register_merge_key`."""
+    default_registry().counter(
+        "strategic_merge_atomic_list_patches_total",
+        "Strategic-merge patches that replaced an unregistered list "
+        "field atomically, by kind and field path.",
+        ("kind", "path"),
+    ).inc(kind or "*", path)
+
+
+def record_list_pagination_restart() -> None:
+    """A chunked LIST's continue token expired mid-pagination (410) and
+    the pager restarted the list from scratch."""
+    default_registry().counter(
+        "list_pagination_restarts_total",
+        "Chunked-LIST restarts after a continue token expired (410).",
+    ).inc()
+
+
 def record_held_queue_overflow() -> None:
     """The held-watch queue hit its cap (stalled CONSUMER, not a server
     410 — a distinct counter so the two failure modes alert separately)."""
