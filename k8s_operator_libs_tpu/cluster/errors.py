@@ -49,6 +49,16 @@ class ExpiredError(ApiError):
     code = 410
 
 
+class InvalidError(ApiError):
+    """422 Unprocessable Entity — the object failed the CRD's OpenAPI
+    structural-schema validation at admission (apimachinery reason
+    ``Invalid``).  The envtest substrate the reference tests against
+    produces these for free (upgrade_suit_test.go:87-93); the in-mem
+    apiserver raises them once the relevant CRD is applied."""
+
+    code = 422
+
+
 class TooManyRequestsError(ApiError):
     """Eviction blocked by a PodDisruptionBudget (the 429 the Eviction
     subresource returns when disruptionsAllowed is 0) — the caller
