@@ -407,7 +407,8 @@ def cmd_repair(args: argparse.Namespace) -> int:
             file=sys.stderr,
         )
         return 3
-    if args.json:
+    if args.json and not args.yes:
+        # Dry run: the machine output IS the plan.
         print(
             json.dumps(
                 [
@@ -416,14 +417,18 @@ def cmd_repair(args: argparse.Namespace) -> int:
                 ]
             )
         )
-    elif not plan:
-        print("no failed nodes with driver pods found; nothing to repair")
-    else:
-        for node, pod, ns in plan:
-            print(
-                f"{node}: delete driver pod {ns}/{pod} (DS recreates at target)"
-            )
+    elif not args.json:
+        if not plan:
+            print("no failed nodes with driver pods found; nothing to repair")
+        else:
+            for node, pod, ns in plan:
+                print(
+                    f"{node}: delete driver pod {ns}/{pod} "
+                    "(DS recreates at target)"
+                )
     if not plan:
+        if args.json and args.yes:
+            print(json.dumps([]))
         return 0
     if not args.yes:
         if not args.json:
@@ -435,15 +440,27 @@ def cmd_repair(args: argparse.Namespace) -> int:
     errors = 0
     from .cluster.errors import NotFoundError
 
+    # With --yes the machine output reports what actually HAPPENED, not
+    # the pre-apply plan: each entry carries applied/error so JSON
+    # consumers never have to reverse-engineer outcomes from stderr and
+    # the exit code.
+    results = []
     for node, pod, ns in plan:
+        entry = {"node": node, "pod": pod, "namespace": ns, "applied": True}
         try:
             cluster.delete("Pod", pod, ns)
         except NotFoundError:
-            continue  # already gone — the DS beat us to it
+            entry["applied"] = False
+            entry["error"] = "already gone (DaemonSet beat us to it)"
         except (ApiError, OSError) as err:
+            entry["applied"] = False
+            entry["error"] = str(err)
             print(f"failed to delete {ns}/{pod}: {err}", file=sys.stderr)
             errors += 1
-    if not args.json:
+        results.append(entry)
+    if args.json:
+        print(json.dumps(results))
+    else:
         print(
             f"repaired {len(plan) - errors}/{len(plan)} pod(s); failed "
             "nodes self-heal once their pods return in sync at the "

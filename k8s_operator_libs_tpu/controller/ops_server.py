@@ -42,6 +42,16 @@ class OpsServer:
     ``port=0`` binds an ephemeral port (tests); read :attr:`port` after
     :meth:`start`.  The server runs daemon threads and never blocks the
     operator; :meth:`stop` shuts it down and joins.
+
+    **Exposure**: the default bind is all-interfaces and UNAUTHENTICATED
+    (matching controller-runtime's metrics/probe listeners — kubelet
+    probes and Prometheus scrapes arrive on the pod IP, so a loopback
+    default would fail every probe).  ``/metrics`` reveals operator
+    internals (rollout counts, watch health) to any pod-network peer;
+    in-cluster deployments should restrict the port with a
+    NetworkPolicy — ``deploy/operator.yaml`` ships one limiting ingress
+    to the monitoring namespace — or pass ``host="127.0.0.1"`` when
+    probes/scrapes are not needed (see docs/real-cluster.md).
     """
 
     def __init__(

@@ -133,13 +133,18 @@ class UpgradeReconciler:
         common = self.manager.common
         if common.get_upgrades_in_progress(state):
             return Result(requeue_after=self.active_requeue_seconds)
+        if self.manager.last_apply_transitions:
+            # The pass just MOVED nodes (e.g. admitted a wave): the
+            # pre-transition snapshot still classifies them as pending-
+            # with-nothing-in-flight, but work is now in flight — stay on
+            # the active cadence.  Watch events usually mask this; a
+            # watch-less/poll-only assembly would otherwise pay the gated
+            # interval per admission wave.
+            return Result(requeue_after=self.active_requeue_seconds)
         if common.get_upgrades_pending(state):
-            # Pending with nothing in flight = gated admissions.  The
-            # snapshot was taken BEFORE apply_state's transitions, so a
-            # just-admitted wave still reports pending here — requeue at
-            # the gated cadence; the next pass sees it in progress and
-            # returns to the active cadence.  Fresh fleets spend exactly
-            # one classification pass here too (same one-cycle cost).
+            # Pending with nothing in flight AND no transitions this
+            # pass = gated admissions (canary bake, closed window,
+            # exhausted pacing) — requeue at the gated cadence.
             return Result(requeue_after=self.gated_requeue_seconds)
         if common.get_upgrades_failed(state):
             return Result(requeue_after=self.failed_requeue_seconds)

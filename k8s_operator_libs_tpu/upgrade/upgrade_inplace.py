@@ -32,6 +32,10 @@ from .common_manager import ClusterUpgradeState, CommonUpgradeManager, NodeUpgra
 
 logger = logging.getLogger(__name__)
 
+#: Units whose missing done-at stamp has already been warned about —
+#: the soak-skip degrade-open is logged once per unit, not per census.
+_soak_skip_logged: set = set()
+
 
 @dataclass
 class CanaryCensus:
@@ -124,6 +128,21 @@ def canary_census(
         if soaking:
             soak_until = max(done_at[u] for u in soaking) + soak
     baked = successful - soaking
+    if soak > 0:
+        # Degrade-open visibility: a done unit with a missing/garbled
+        # done-at stamp (upgraded before this release, or a corrupted
+        # annotation) counts as already soaked.  Intentional — but say
+        # so ONCE per unit, so an operator can see the bake window was
+        # skipped rather than silently waived.
+        for u in baked:
+            if done_at.get(u, 0.0) == 0.0 and u not in _soak_skip_logged:
+                _soak_skip_logged.add(u)
+                logger.warning(
+                    "canary unit %s is done without a parsable done-at "
+                    "stamp; treating it as already soaked (the "
+                    "canarySoakSeconds bake window is skipped for it)",
+                    u,
+                )
     passed = len(baked) >= policy.canary_domains
     return CanaryCensus(
         stamped=frozenset(stamped),

@@ -82,6 +82,9 @@ class ClusterUpgradeStateManager:
         self._cluster = cluster
         self._cache = cache or InformerCache(cluster, lag_seconds=0.0)
         self._recorder = recorder
+        #: Synchronous state transitions performed by the most recent
+        #: apply_state pass (see that method's docstring).
+        self.last_apply_transitions = 0
         self._provider = provider or NodeUpgradeStateProvider(
             cluster,
             self._cache,
@@ -319,7 +322,13 @@ class ClusterUpgradeStateManager:
     def apply_state(
         self, state: Optional[ClusterUpgradeState], policy: Optional[UpgradePolicySpec]
     ) -> None:
-        """The 11-phase hot loop (reference: ApplyState, :171-281)."""
+        """The 11-phase hot loop (reference: ApplyState, :171-281).
+
+        Sets :attr:`last_apply_transitions` — how many synchronous state
+        transitions this pass performed (admissions, cordons, ...); the
+        reconciler uses it to stay on the active cadence right after an
+        admission wave instead of sleeping the gated interval."""
+        self.last_apply_transitions = 0
         if state is None:
             raise UpgradeStateError("currentState should not be empty")
         if policy is not None:
@@ -521,6 +530,18 @@ class ClusterUpgradeStateManager:
             # 11. uncordon (both modes' processors run — reference :311-325)
             lambda: self._process_uncordon_required_nodes_wrapper(state),
         ]
+        # Count this pass's synchronous state transitions (thread-local
+        # listener — async drain/eviction workers excluded).  The
+        # reconciler reads last_apply_transitions to pick its requeue
+        # cadence: a pass that just ADMITTED a wave still snapshots as
+        # pending-with-nothing-in-flight (the snapshot predates the
+        # transitions), and without this signal a watch-less assembly
+        # pays the gated 5 s cadence per admission wave.
+        transitions = {"n": 0}
+
+        def _count(node, new_state, _t=transitions):
+            _t["n"] += 1
+
         barrier = (
             self._provider.deferred_visibility()
             if self._deferred_visibility
@@ -528,8 +549,9 @@ class ClusterUpgradeStateManager:
         )
         with barrier:
             if not self._cascade:
-                for phase in phases:
-                    phase()
+                with self._provider.transition_listener(_count):
+                    for phase in phases:
+                        phase()
             else:
                 # Pipelined reconcile: a state write migrates the node into
                 # its new bucket *between* phases, so one pass carries a
@@ -549,12 +571,16 @@ class ClusterUpgradeStateManager:
                     if ns.node is not None
                 }
                 moves: list = []
-                with self._provider.transition_listener(
-                    lambda node, new_state: moves.append((node, new_state))
-                ):
+
+                def _record(node, new_state):
+                    _count(node, new_state)
+                    moves.append((node, new_state))
+
+                with self._provider.transition_listener(_record):
                     for phase in phases:
                         phase()
                         self._migrate_buckets(state, moves, index)
+        self.last_apply_transitions = transitions["n"]
 
     @staticmethod
     def _migrate_buckets(

@@ -758,3 +758,87 @@ class TestOpsServer:
         finally:
             ctrl.stop()
         assert not ctrl.running()
+
+
+class TestAdmissionWaveCadence:
+    """ADVICE r3: a pass that just admitted a wave snapshots as
+    pending-with-nothing-in-flight; the reconciler must requeue at the
+    ACTIVE cadence (work is now in flight), not the gated one — a
+    watch-less/poll-only assembly otherwise pays ~5 s per wave."""
+
+    def test_admission_pass_requeues_at_active_cadence(self, cluster):
+        from k8s_operator_libs_tpu.controller.upgrade_reconciler import (
+            UpgradeReconciler,
+        )
+
+        fleet = Fleet(cluster, revision_hash="v1")
+        for h in range(2):
+            fleet.add_node(f"host{h}")
+        fleet.publish_new_revision("v2")
+        manager = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        rec = UpgradeReconciler(
+            manager=manager,
+            namespace=NAMESPACE,
+            driver_labels=DRIVER_LABELS,
+            policy=UpgradePolicySpec(
+                auto_upgrade=True,
+                max_parallel_upgrades=1,
+                drain_spec=DrainSpec(enable=True, force=True),
+            ),
+            active_requeue_seconds=0.02,
+            gated_requeue_seconds=5.0,
+        )
+        result = rec.reconcile("upgrade-cycle")
+        # the first pass ADMITS host(s): transitions occurred, so the
+        # requeue must be the active cadence even though the snapshot
+        # still classified everything as pending
+        assert manager.last_apply_transitions > 0
+        assert result is not None
+        assert result.requeue_after == pytest.approx(0.02)
+
+    def test_gated_pass_keeps_gated_cadence(self, cluster):
+        """A genuinely gated pass (admissions blocked by a closed
+        maintenance window) performs no transitions and stays on the
+        gated cadence — the hot-loop guard is not regressed.  The FIRST
+        pass still classifies fresh nodes (transitions → active cadence,
+        correct); the SECOND is the steady gated state."""
+        import datetime as _dt
+
+        from k8s_operator_libs_tpu.api.upgrade_spec import MaintenanceWindowSpec
+        from k8s_operator_libs_tpu.controller.upgrade_reconciler import (
+            UpgradeReconciler,
+        )
+
+        fleet = Fleet(cluster, revision_hash="v1")
+        fleet.add_node("host0")
+        fleet.publish_new_revision("v2")
+        manager = ClusterUpgradeStateManager(
+            cluster, cache_sync_timeout_seconds=2.0, cache_sync_poll_seconds=0.01
+        )
+        # a 1-hour window starting 6 h from now (UTC): closed for the
+        # whole test no matter when it runs
+        start = (
+            _dt.datetime.now(_dt.timezone.utc) + _dt.timedelta(hours=6)
+        ).strftime("%H:00")
+        rec = UpgradeReconciler(
+            manager=manager,
+            namespace=NAMESPACE,
+            driver_labels=DRIVER_LABELS,
+            policy=UpgradePolicySpec(
+                auto_upgrade=True,
+                max_parallel_upgrades=1,
+                maintenance_window=MaintenanceWindowSpec(
+                    start=start, duration_minutes=60
+                ),
+                drain_spec=DrainSpec(enable=True, force=True),
+            ),
+            active_requeue_seconds=0.02,
+            gated_requeue_seconds=5.0,
+        )
+        rec.reconcile("upgrade-cycle")  # classification pass
+        result = rec.reconcile("upgrade-cycle")  # steady gated pass
+        assert manager.last_apply_transitions == 0
+        assert result is not None
+        assert result.requeue_after == pytest.approx(5.0)

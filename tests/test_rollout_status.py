@@ -839,3 +839,38 @@ class TestRepairCli:
         data = json.loads(capsys.readouterr().out)
         assert rc == 0
         assert len(data) == 1 and data[0]["node"] == "sick"
+
+    def test_json_yes_reports_apply_outcomes(self, cluster, tmp_path, capsys):
+        """ADVICE r3: with --yes the JSON output must report what
+        actually happened (applied/error per entry), not the pre-apply
+        plan — machine consumers otherwise never learn which deletions
+        succeeded."""
+        from k8s_operator_libs_tpu.cluster import ApiServerFacade
+
+        self._failed_fleet(cluster)
+        with ApiServerFacade(cluster) as facade:
+            kc = self._kubeconfig(tmp_path, facade.url)
+            rc = cli_main(["repair", "--kubeconfig", kc, "--json", "--yes"])
+        data = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert len(data) == 1
+        assert data[0]["node"] == "sick"
+        assert data[0]["applied"] is True
+        assert "error" not in data[0]
+        # the pod really is gone
+        pods = cluster.list("Pod", namespace=NAMESPACE)
+        assert all(
+            (p.get("spec") or {}).get("nodeName") != "sick" for p in pods
+        )
+
+    def test_json_yes_empty_plan_prints_empty_list(
+        self, cluster, tmp_path, capsys
+    ):
+        from k8s_operator_libs_tpu.cluster import ApiServerFacade
+
+        Fleet(cluster).add_node("healthy", pod_hash="rev1")
+        with ApiServerFacade(cluster) as facade:
+            kc = self._kubeconfig(tmp_path, facade.url)
+            rc = cli_main(["repair", "--kubeconfig", kc, "--json", "--yes"])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out) == []

@@ -852,6 +852,39 @@ class TestCanarySoak:
         census = canary_census(state, policy)
         assert census.passed
 
+    def test_missing_stamp_degrade_open_warns_once(self, cluster, caplog):
+        """ADVICE r3: the degrade-open must be VISIBLE — one warning per
+        unit the first time an unstamped done unit skips the bake
+        window, and silence on repeat censuses."""
+        import logging as _logging
+
+        from k8s_operator_libs_tpu.upgrade import upgrade_inplace
+        from k8s_operator_libs_tpu.upgrade.upgrade_inplace import canary_census
+
+        fleet = self._fleet(cluster)
+        manager = _make_manager(cluster)
+        policy = self._policy(canary_soak_seconds=3600.0)
+        self._run_canary_to_done(cluster, fleet, manager, policy)
+        key = util.get_done_at_annotation_key()
+        for node in cluster.list("Node"):
+            annotations = node["metadata"].get("annotations") or {}
+            if key in annotations:
+                del annotations[key]
+                cluster.update(node)
+        upgrade_inplace._soak_skip_logged.clear()
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        with caplog.at_level(_logging.WARNING, logger=upgrade_inplace.__name__):
+            canary_census(state, policy)
+            first = [
+                r for r in caplog.records if "already soaked" in r.message
+            ]
+            assert len(first) >= 1
+            caplog.clear()
+            canary_census(state, policy)  # repeat census: quiet
+            assert not [
+                r for r in caplog.records if "already soaked" in r.message
+            ]
+
     def test_policy_round_trip_and_validation(self):
         from k8s_operator_libs_tpu.api import ValidationError
         import pytest as _pytest
