@@ -30,9 +30,13 @@ built-in keys (extensible via :func:`register_merge_key`):
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+import logging
+from typing import Any, Dict, Optional, Set, Tuple
 
+from .. import metrics
 from .errors import BadRequestError
+
+logger = logging.getLogger(__name__)
 
 #: (kind or "*", dotted field path) -> merge key.  The core subset of
 #: Kubernetes' struct-tag table that fleet tooling actually patches.
@@ -45,24 +49,59 @@ def register_merge_key(path: str, key: str, kind: str = "*") -> None:
     MERGE_KEYS[(kind, path)] = key
 
 
+# The struct-tag (`patchMergeKey`) table for every kind this library
+# serves, transcribed from the upstream k8s.io/api type definitions
+# (PodSpec / Container / NodeStatus / ObjectMeta et al).  Lists absent
+# here — tolerations, finalizers, container args/command — are atomic
+# in the real apiserver too (no patchMergeKey tag), so the atomic
+# fallback below is correct for them, not a gap.
 for _path, _key in (
+    # ObjectMeta (every kind)
+    ("metadata.ownerReferences", "uid"),
+    # PodSpec
     ("spec.containers", "name"),
     ("spec.initContainers", "name"),
+    ("spec.ephemeralContainers", "name"),
     ("spec.volumes", "name"),
+    ("spec.imagePullSecrets", "name"),
+    ("spec.hostAliases", "ip"),
+    ("spec.topologySpreadConstraints", "topologyKey"),
+    ("spec.resourceClaims", "name"),
+    # Container / EphemeralContainer
     ("spec.containers.env", "name"),
     ("spec.containers.ports", "containerPort"),
     ("spec.containers.volumeMounts", "mountPath"),
+    ("spec.containers.volumeDevices", "devicePath"),
     ("spec.initContainers.env", "name"),
-    ("spec.imagePullSecrets", "name"),
-    ("spec.taints", "key"),  # Node taints — the fleet-tooling classic
+    ("spec.initContainers.ports", "containerPort"),
+    ("spec.initContainers.volumeMounts", "mountPath"),
+    ("spec.initContainers.volumeDevices", "devicePath"),
+    # NodeSpec / NodeStatus (status.images / status.volumesAttached are
+    # untagged upstream — atomic there, atomic here)
+    ("spec.taints", "key"),  # the fleet-tooling classic
+    ("status.addresses", "type"),
+    # Conditions (Pod/Node/PDB/CRD status all tag by type)
     ("status.conditions", "type"),
+    # Pod templates (DaemonSet.spec.template.spec.*)
     ("spec.template.spec.containers", "name"),
     ("spec.template.spec.initContainers", "name"),
     ("spec.template.spec.volumes", "name"),
+    ("spec.template.spec.imagePullSecrets", "name"),
+    ("spec.template.spec.hostAliases", "ip"),
+    ("spec.template.spec.topologySpreadConstraints", "topologyKey"),
     ("spec.template.spec.containers.env", "name"),
     ("spec.template.spec.containers.ports", "containerPort"),
+    ("spec.template.spec.containers.volumeMounts", "mountPath"),
+    ("spec.template.spec.containers.volumeDevices", "devicePath"),
+    ("spec.template.spec.initContainers.env", "name"),
+    ("spec.template.spec.initContainers.ports", "containerPort"),
+    ("spec.template.spec.initContainers.volumeMounts", "mountPath"),
 ):
     register_merge_key(_path, _key)
+
+#: (kind, path) pairs already warned about — the atomic-list fallback is
+#: logged once per field, not per patch (ADVICE r3: silence was the bug).
+_atomic_warned: Set[Tuple[str, str]] = set()
 
 
 def _merge_key_for(kind: str, path: str) -> Optional[str]:
@@ -135,6 +174,31 @@ def _merge_list(target: Any, patch: list, kind: str, path: str) -> list:
         # still honor an explicit replace directive for clarity.  Any
         # other directive in an atomic list would be stored literally,
         # so fail loudly instead.
+        #
+        # Loudness (ADVICE r3): when the replaced list holds OBJECTS, a
+        # real apiserver might have keyed-merged it (if its struct tags
+        # cover the field and this registry does not) — count every such
+        # patch and warn once per field so the divergence is visible
+        # instead of silent.
+        explicit_replace = any(
+            isinstance(e, dict) and e.get("$patch") == "replace"
+            for e in patch
+        )
+        if not explicit_replace and any(
+            isinstance(e, dict) and "$patch" not in e for e in patch
+        ):
+            metrics.record_atomic_list_patch(kind, path)
+            if (kind, path) not in _atomic_warned:
+                _atomic_warned.add((kind, path))
+                logger.warning(
+                    "strategic merge: list at %r (kind %s) has no "
+                    "registered merge key — replacing it ATOMICALLY.  If "
+                    "a real apiserver keyed-merges this field, register "
+                    "the key with register_merge_key(%r, <key>)",
+                    path,
+                    kind,
+                    path,
+                )
         for e in patch:
             if (
                 isinstance(e, dict)
