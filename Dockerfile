@@ -1,0 +1,28 @@
+# Operator runtime image — the deployable form of the assembled
+# operator (examples/operator.py --in-cluster).  Analog of the
+# reference's containerize-your-binary consumer story
+# (pkg/crdutil/README.md:30-63); the reference itself ships only a
+# build image (docker/Dockerfile.devel) because it is a library — this
+# repo additionally ships the runnable operator, so the image runs it.
+#
+# Build:  make image            (tag: k8s-operator-libs-tpu:dev)
+# Run:    see deploy/operator.yaml (ServiceAccount + RBAC + probes)
+#
+# The control plane needs only PyYAML; jax and the TPU layer are an
+# optional extra (the operator degrades gracefully without a chip — the
+# checkpoint-on-drain gate is only assembled when requested).
+FROM python:3.12-slim
+
+RUN pip install --no-cache-dir pyyaml && useradd --uid 65532 operator
+
+WORKDIR /app
+COPY k8s_operator_libs_tpu/ k8s_operator_libs_tpu/
+COPY examples/ examples/
+COPY hack/crd/ hack/crd/
+
+USER 65532:65532
+# /healthz /readyz served on the ops port for kubelet probes
+# (deploy/operator.yaml wires them); --in-cluster reads the mounted
+# ServiceAccount token like rest.InClusterConfig.
+ENTRYPOINT ["python", "examples/operator.py"]
+CMD ["--in-cluster", "--ops-port", "8080"]

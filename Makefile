@@ -5,8 +5,12 @@
 # style checks available in the base image.
 
 PYTHON ?= python
+DOCKER ?= docker
+IMAGE ?= k8s-operator-libs-tpu:dev
+BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 
-.PHONY: all test test-fast lint bench smoke graft-check cov-report clean help
+.PHONY: all test test-fast lint bench smoke graft-check cov-report clean help \
+	image .build-image kind-e2e tpu-smoke
 
 all: lint test
 
@@ -45,6 +49,31 @@ graft-check:
 cov-report:
 	$(PYTHON) -m pytest tests/ -q --cov=k8s_operator_libs_tpu --cov-report=term 2>/dev/null \
 		|| $(PYTHON) -m pytest tests/ -q  # pytest-cov not installed: plain run
+
+# Operator runtime image (Dockerfile) — deployed by deploy/operator.yaml.
+image:
+	$(DOCKER) build --tag $(IMAGE) .
+
+# Containerized builds — the reference's docker-% pattern
+# (Makefile:95-125): `make docker-lint` / `make docker-test` run the
+# target inside the pinned build image so results match CI on any host.
+.build-image: docker/Dockerfile.devel
+	$(DOCKER) build --tag $(BUILDIMAGE) -f docker/Dockerfile.devel docker
+
+docker-%: .build-image
+	$(DOCKER) run --rm -v $(PWD):$(PWD) -w $(PWD) \
+		--user $$(id -u):$$(id -g) -e HOME=/tmp $(BUILDIMAGE) make $(*)
+
+# Real-apiserver e2e: kind cluster + deployed operator + scripted
+# DS-revision bump; prints nodes-upgraded/min (the BASELINE proxy).
+# Needs docker + kind + kubectl on the host (CI job: kind-e2e).
+kind-e2e:
+	bash hack/kind-e2e.sh
+
+# Run the TPU layer on real TPU silicon (skips cleanly when no chip):
+# demo trainer + checkpoint-on-drain handshake, step time + tokens/s.
+tpu-smoke:
+	$(PYTHON) hack/tpu_smoke.py
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -35,7 +35,15 @@ from k8s_operator_libs_tpu.cluster import InMemoryCluster
 from k8s_operator_libs_tpu.controller import new_upgrade_controller
 from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager, consts, util
 
-from harness import DRIVER_LABELS, NAMESPACE, Fleet
+# The in-memory DEMO mode simulates the fleet with the test harness;
+# the deployed operator image ships without tests/, so real-cluster
+# mode must not require it (run_demo imports Fleet lazily).
+try:
+    from harness import DRIVER_LABELS, NAMESPACE, Fleet
+except ImportError:  # deployed image: real-cluster mode only
+    DRIVER_LABELS = {"app": "tpu-runtime"}
+    NAMESPACE = "tpu-ops"
+    Fleet = None
 
 
 def run_real(args) -> int:
@@ -217,6 +225,14 @@ def main() -> int:
 
 
 def run_demo() -> int:
+    if Fleet is None:
+        print(
+            "error: the in-memory demo needs tests/harness.py (run from "
+            "a source checkout); in the deployed image use --in-cluster "
+            "or --kubeconfig",
+            file=sys.stderr,
+        )
+        return 2
     util.set_component_name("tpu-runtime")
     cluster = InMemoryCluster()
     fleet = Fleet(cluster, revision_hash="v1")

@@ -1,0 +1,149 @@
+#!/usr/bin/env bash
+# Real-apiserver e2e (VERDICT r3 task 1): run the DEPLOYED operator
+# against a genuine Kubernetes cluster and measure the BASELINE proxy,
+# nodes-upgraded/min.
+#
+# What is real here (vs the in-repo ApiServerFacade substrate):
+#   * the apiserver: opaque RVs, chunked LISTs, admission/schema
+#     validation, real watch streams — everything round 3 could not
+#     prove;
+#   * the DaemonSet controller: recreates the driver pods the operator
+#     deletes (in-repo tests hand-roll this with Fleet.reconcile_daemonset);
+#   * the kubelets: kind's 3 worker nodes actually run the driver pods,
+#     confirm termination, report readiness.
+#
+# Flow (reference analog: the envtest strategy of Makefile:76-78 +
+# upgrade_suit_test.go:87-93, upgraded from a bare apiserver to a full
+# cluster):
+#   1. kind cluster (1 control plane + 3 workers)
+#   2. build the operator image, kind-load it
+#   3. apply this repo's CRDs with examples/apply_crds.py --kubeconfig
+#      (the library's own client against the real apiserver)
+#   4. kubectl apply -f deploy/operator.yaml  (the DEPLOY story, not a
+#      host process)
+#   5. an OnDelete driver DaemonSet (hack/e2e-driver-ds.yaml) + a
+#      TpuUpgradePolicy CR
+#   6. bump the DS image -> new ControllerRevision; the operator must
+#      cordon/drain/delete/verify each worker; wait until every worker
+#      carries the upgrade-done label AND every driver pod runs the new
+#      image
+#   7. print {"metric": "kind_nodes_upgraded_per_min", ...}
+#
+# Requirements: docker, kind, kubectl, python3 (pyyaml).  CI runs this
+# in the kind-e2e job; locally: make kind-e2e.
+set -euo pipefail
+
+CLUSTER_NAME="${KIND_CLUSTER_NAME:-tpu-e2e}"
+IMAGE="${IMAGE:-k8s-operator-libs-tpu:dev}"
+NS=tpu-ops
+STATE_LABEL="tpu.google.com/tpu-runtime-upgrade-state"
+DONE_STATE="upgrade-done"
+NEW_IMAGE="busybox:1.37"
+TIMEOUT_S="${E2E_TIMEOUT_S:-420}"
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+
+log() { echo "[kind-e2e] $*" >&2; }
+die() { log "FAIL: $*"; exit 1; }
+
+for tool in docker kind kubectl python3; do
+  command -v "$tool" >/dev/null || die "$tool is required"
+done
+
+cleanup() {
+  rc=$?
+  if [ $rc -ne 0 ]; then
+    log "---- operator logs (tail) ----"
+    kubectl -n "$NS" logs deployment/tpu-upgrade-operator --tail=60 >&2 || true
+    log "---- nodes ----"
+    kubectl get nodes --show-labels >&2 || true
+    log "---- pods ----"
+    kubectl -n "$NS" get pods -o wide >&2 || true
+  fi
+  if [ "${KEEP_CLUSTER:-0}" != "1" ]; then
+    kind delete cluster --name "$CLUSTER_NAME" >/dev/null 2>&1 || true
+  fi
+  exit $rc
+}
+trap cleanup EXIT
+
+log "1/7 creating kind cluster ($CLUSTER_NAME: 1 control plane + 3 workers)"
+kind delete cluster --name "$CLUSTER_NAME" >/dev/null 2>&1 || true
+kind create cluster --name "$CLUSTER_NAME" --config "$ROOT/hack/kind-cluster.yaml" --wait 120s
+KUBECONFIG_FILE="$(mktemp)"
+kind get kubeconfig --name "$CLUSTER_NAME" > "$KUBECONFIG_FILE"
+export KUBECONFIG="$KUBECONFIG_FILE"
+
+log "2/7 building + loading the operator image"
+docker build -q -t "$IMAGE" "$ROOT"
+kind load docker-image "$IMAGE" --name "$CLUSTER_NAME"
+docker pull -q busybox:1.36 && kind load docker-image busybox:1.36 --name "$CLUSTER_NAME" || true
+docker pull -q "$NEW_IMAGE" && kind load docker-image "$NEW_IMAGE" --name "$CLUSTER_NAME" || true
+
+log "3/7 applying CRDs with the library's own client (real apiserver contact)"
+python3 "$ROOT/examples/apply_crds.py" --crds-path "$ROOT/hack/crd/bases" \
+  --operation apply --kubeconfig "$KUBECONFIG_FILE"
+
+log "4/7 deploying the operator from deploy/operator.yaml"
+kubectl apply -f "$ROOT/deploy/operator.yaml"
+
+log "5/7 driver DaemonSet + policy CR"
+kubectl apply -f "$ROOT/hack/e2e-driver-ds.yaml"
+kubectl -n "$NS" rollout status ds/tpu-runtime --timeout=120s
+kubectl apply -f - <<EOF
+apiVersion: tpu.google.com/v1alpha1
+kind: TpuUpgradePolicy
+metadata:
+  name: fleet-policy
+  namespace: $NS
+spec:
+  autoUpgrade: true
+  maxParallelUpgrades: 0
+  maxUnavailable: "50%"
+  drain:
+    enable: true
+    force: true
+    timeoutSeconds: 60
+EOF
+kubectl -n "$NS" rollout status deployment/tpu-upgrade-operator --timeout=180s
+
+WORKERS=$(kubectl get nodes -o name | grep -c worker) || die "no workers"
+log "workers under management: $WORKERS"
+
+log "6/7 publishing the new driver revision ($NEW_IMAGE) and timing the rollout"
+START=$(date +%s)
+kubectl -n "$NS" set image ds/tpu-runtime runtime="$NEW_IMAGE"
+
+deadline=$((START + TIMEOUT_S))
+while :; do
+  now=$(date +%s)
+  [ "$now" -lt "$deadline" ] || die "rollout did not converge in ${TIMEOUT_S}s"
+  done_nodes=$(kubectl get nodes -l "${STATE_LABEL}=${DONE_STATE}" -o name | grep -c worker || true)
+  new_pods=$(kubectl -n "$NS" get pods -l app=tpu-runtime \
+    -o jsonpath='{range .items[*]}{.spec.containers[0].image}{"\n"}{end}' \
+    | grep -c "$NEW_IMAGE" || true)
+  ready_pods=$(kubectl -n "$NS" get pods -l app=tpu-runtime \
+    -o jsonpath='{range .items[*]}{.status.conditions[?(@.type=="Ready")].status}{"\n"}{end}' \
+    | grep -c True || true)
+  cordoned=$(kubectl get nodes -o jsonpath='{range .items[?(@.spec.unschedulable==true)]}{.metadata.name}{"\n"}{end}' | grep -c . || true)
+  log "done=$done_nodes/$WORKERS newImage=$new_pods ready=$ready_pods cordoned=$cordoned"
+  if [ "$done_nodes" -eq "$WORKERS" ] && [ "$new_pods" -eq "$WORKERS" ] \
+     && [ "$ready_pods" -eq "$WORKERS" ] && [ "$cordoned" -eq 0 ]; then
+    break
+  fi
+  sleep 5
+done
+END=$(date +%s)
+ELAPSED=$((END - START))
+
+log "7/7 converged in ${ELAPSED}s"
+python3 - "$WORKERS" "$ELAPSED" <<'EOF'
+import json, sys
+workers, elapsed = int(sys.argv[1]), max(int(sys.argv[2]), 1)
+print(json.dumps({
+    "metric": "kind_nodes_upgraded_per_min",
+    "value": round(workers * 60.0 / elapsed, 3),
+    "unit": "nodes/min",
+    "detail": {"workers": workers, "elapsed_s": elapsed,
+               "cluster": "kind 1cp+3w, real apiserver/DS-controller/kubelets"},
+}))
+EOF
