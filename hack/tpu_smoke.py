@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""make tpu-smoke — run the demo trainer + checkpoint-on-drain
+handshake on REAL TPU silicon and print one JSON line.
+
+Skips cleanly (exit 0, ``skipped: true``) when no TPU is visible, so
+the target is safe in every environment; pass ``--allow-cpu`` to run
+the same measurement on CPU (useful for validating the script itself —
+the output is labeled with the actual platform either way, so a CPU
+run can never masquerade as silicon).
+
+VERDICT r3 task 4: BENCH artifacts must contain a number produced by
+TPU hardware — bench.py embeds the same measurement as its ``tpu``
+section; this CLI is the standalone/debuggable form.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--allow-cpu",
+        action="store_true",
+        help="run on CPU when no TPU is present (still labeled cpu)",
+    )
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--batch-size", type=int, default=8)
+    args = parser.parse_args()
+
+    from k8s_operator_libs_tpu.tpu.smoke import detect_tpu, run_smoke
+
+    tpu = detect_tpu()
+    if tpu is None and not args.allow_cpu:
+        print(
+            json.dumps(
+                {
+                    "metric": "tpu_smoke",
+                    "skipped": True,
+                    "reason": "no TPU device visible (pass --allow-cpu "
+                    "to run the same measurement on CPU)",
+                }
+            )
+        )
+        return 0
+    with tempfile.TemporaryDirectory(prefix="tpu-smoke-ckpt-") as ckpt:
+        result = run_smoke(
+            checkpoint_dir=ckpt,
+            steps=args.steps,
+            batch_size=args.batch_size,
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "tpu_step_time_ms",
+                "value": result["step_time_ms"],
+                "unit": "ms",
+                "detail": result,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
