@@ -2496,3 +2496,114 @@ class TestStrategicMergeLoudness:
             assert not caplog.records
         finally:
             metrics_mod.set_default_registry(prev)
+
+
+class TestPerKindDeliveryFloors:
+    """VERDICT r3 task 8: the bounded-poll path must never let one
+    kind's resourceVersion churn decide whether another kind's frame is
+    delivered — floors are per-kind, pinned when the kind's watch is
+    established."""
+
+    def _client(self, store):
+        facade = ApiServerFacade(store).start()
+        return facade, KubeApiClient(KubeConfig(server=facade.url), timeout=10.0)
+
+    def test_late_arriving_kind_not_swallowed_by_global_cursor(self):
+        """The regression the global filter had: a Pod frame whose RV is
+        below a cursor advanced by Node churn must still be delivered
+        the first time the Pod kind is polled for it."""
+        store = InMemoryCluster()
+        store.create(make_node("n1"))
+        store.create(make_pod("p1", "ml", "n1"))
+        facade, client = self._client(store)
+        try:
+            # establish watches for both kinds at cursor 0
+            client.events_since(0, kind=("Node", "Pod"))
+            # a Pod write (low RV) followed by Node churn (higher RVs)
+            client.patch("Pod", "p1", {"metadata": {"labels": {"x": "1"}}}, "ml")
+            for i in range(5):
+                client.patch("Node", "n1", {"metadata": {"labels": {"i": str(i)}}})
+            head = store.journal_seq()
+            # a Node-only poll advances the caller's global cursor to head
+            node_events = client.events_since(0, kind=("Node",))
+            assert node_events, "node churn must be visible"
+            # now the caller polls BOTH kinds with its advanced cursor:
+            # the Pod frame's RV < head, but it was never delivered —
+            # per-kind floors must deliver it
+            events = client.events_since(head, kind=("Node", "Pod"))
+            pod_events = [
+                e for e in events if (e.new or e.old or {}).get("kind") == "Pod"
+            ]
+            assert pod_events, (
+                "Pod frame swallowed by a cursor advanced by Node churn"
+            )
+            assert pod_events[0].new["metadata"]["labels"]["x"] == "1"
+        finally:
+            facade.stop()
+
+    def test_no_duplicate_delivery_within_a_kind(self):
+        store = InMemoryCluster()
+        store.create(make_node("n1"))
+        facade, client = self._client(store)
+        try:
+            client.events_since(0, kind=("Node",))
+            client.patch("Node", "n1", {"metadata": {"labels": {"a": "1"}}})
+            first = client.events_since(0, kind=("Node",))
+            assert len(first) == 1
+            # same cursor again: already delivered for this kind
+            again = client.events_since(0, kind=("Node",))
+            assert again == []
+        finally:
+            facade.stop()
+
+    def test_interleaved_multi_kind_writes_per_kind_order(self):
+        """Interleaved Node/Pod writes: each kind's events arrive in
+        that kind's write order (per-kind positions are exact); no
+        cross-kind guarantee is asserted — that is the API contract."""
+        store = InMemoryCluster()
+        store.create(make_node("n1"))
+        store.create(make_pod("p1", "ml", "n1"))
+        facade, client = self._client(store)
+        try:
+            client.events_since(0, kind=("Node", "Pod"))
+            for i in range(4):
+                client.patch(
+                    "Node", "n1", {"metadata": {"labels": {"i": str(i)}}}
+                )
+                client.patch(
+                    "Pod", "p1", {"metadata": {"labels": {"i": str(i)}}}, "ml"
+                )
+            events = client.events_since(0, kind=("Node", "Pod"))
+            for want_kind in ("Node", "Pod"):
+                ours = [
+                    (e.new or {}).get("metadata", {}).get("labels", {}).get("i")
+                    for e in events
+                    if (e.new or e.old or {}).get("kind") == want_kind
+                ]
+                assert ours == ["0", "1", "2", "3"], (want_kind, ours)
+        finally:
+            facade.stop()
+
+    def test_floor_resets_with_kind_state_on_410(self):
+        store = InMemoryCluster()
+        store._journal_cap = 5
+        store.create(make_node("n1"))
+        facade, client = self._client(store)
+        try:
+            client.events_since(0, kind=("Node",))
+            for i in range(12):  # roll the journal past the bookmark
+                store.create(make_node(f"extra{i}"))
+            with pytest.raises(ExpiredError):
+                client.events_since(0, kind=("Node",))
+            assert "Node" not in client._kind_delivered
+            # recovery: relist + resume delivers subsequent events
+            client.list("Node")
+            client.events_since(store.journal_seq(), kind=("Node",))
+            client.patch("Node", "n1", {"metadata": {"labels": {"back": "1"}}})
+            events = client.events_since(store.journal_seq() - 1, kind=("Node",))
+            assert any(
+                (e.new or {}).get("metadata", {}).get("labels", {}).get("back")
+                for e in events
+            )
+        finally:
+            facade.stop()
