@@ -28,6 +28,7 @@ test-fast:
 lint:
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu examples bench.py __graft_entry__.py
 	$(PYTHON) hack/lint.py
+	$(PYTHON) hack/typecheck.py k8s_operator_libs_tpu examples bench.py __graft_entry__.py hack
 
 bench:
 	$(PYTHON) bench.py
