@@ -98,6 +98,11 @@ def _with_gvk(obj: JsonObj, info: KindInfo) -> JsonObj:
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "ApiServerFacade/1.0"
+    # The status line/headers and the body leave in separate small
+    # writes; with Nagle on, each response stalls ~40 ms against the
+    # peer's delayed ACK — per request.  Real apiservers disable Nagle
+    # on accepted connections (Go's net/http does by default).
+    disable_nagle_algorithm = True
 
     # Set by ApiServerFacade
     cluster: InMemoryCluster

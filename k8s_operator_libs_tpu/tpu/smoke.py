@@ -152,10 +152,13 @@ def run_smoke(
     completed = trainer.run(50)  # must stop at the drain, not at 50
     node = cluster.get("Node", "tpu-host")
     ack = (node["metadata"].get("annotations") or {}).get(key, "")
-    assert trainer.drained, "trainer ignored the drain request"
-    assert ack.startswith(consts.PRE_DRAIN_CHECKPOINT_DONE), (
-        f"drain not acknowledged: {ack!r}"
-    )
+    # Explicit raises, not asserts: this validation must survive
+    # python -O (bench runs must never report a handshake that did not
+    # actually happen).
+    if not trainer.drained:
+        raise RuntimeError("trainer ignored the drain request")
+    if not ack.startswith(consts.PRE_DRAIN_CHECKPOINT_DONE):
+        raise RuntimeError(f"drain not acknowledged: {ack!r}")
 
     restored = restore_checkpoint(
         checkpoint_dir,
@@ -166,7 +169,10 @@ def run_smoke(
             "opt_state": jax.device_get(trainer.opt_state),
         },
     )
-    assert restored["step"] == completed
+    if restored["step"] != completed:
+        raise RuntimeError(
+            f"checkpoint step {restored['step']} != drained step {completed}"
+        )
     # resume: a fresh trainer continues from the restored state
     resumed = CheckpointingTrainer(
         config, checkpoint_dir, watcher=None, batch_size=batch_size
@@ -175,7 +181,10 @@ def run_smoke(
     resumed.opt_state = jax.device_put(restored["opt_state"])
     resumed.step = restored["step"]
     resumed.run(2)
-    assert resumed.step == completed + 2
+    if resumed.step != completed + 2:
+        raise RuntimeError(
+            f"resume ran to step {resumed.step}, want {completed + 2}"
+        )
     result["drain_handshake"] = {
         "checkpoint_step": completed,
         "ack": ack.split(":", 1)[0],
