@@ -61,6 +61,16 @@ class InformerCache:
         self.lag_seconds = lag_seconds
         self._kinds = tuple(sorted(kinds)) if kinds else None
         self._lock = threading.Lock()
+        # Refresh serialization — the single-reflector rule.  Reads come
+        # from many threads (drain/pod workers polling visibility), but
+        # only ONE may consume the journal at a time: on HTTP backends
+        # the held-watch queue is pop-once, so two concurrent
+        # events_since calls would SPLIT the stream between them and
+        # apply frames out of order across threads (observed as a node
+        # regressing to an older resourceVersion until its next write —
+        # cache-visibility waits then time out).  RLock because the 410
+        # path (_refresh -> sync) re-enters.
+        self._refresh_serial = threading.RLock()
         self._snapshot: Dict[Key, JsonObj] = {}
         self._last_seq = 0
         self._last_sync = float("-inf")
@@ -79,42 +89,68 @@ class InformerCache:
         # Head first: events recorded between the head read and the
         # snapshot are re-applied by the next incremental pass —
         # idempotent, loss-free (same ordering as Controller._watch_loop).
-        seq = self._cluster.journal_seq()
-        snap = self._cluster.snapshot(self._kinds)
-        with self._lock:
-            self._snapshot = snap
-            self._last_seq = seq
-            self._last_sync = time.monotonic()
-            self.full_syncs += 1
+        with self._refresh_serial:
+            seq = self._cluster.journal_seq()
+            snap = self._cluster.snapshot(self._kinds)
+            with self._lock:
+                self._snapshot = snap
+                self._last_seq = seq
+                self._last_sync = time.monotonic()
+                self.full_syncs += 1
 
     def _refresh(self) -> None:
-        """Advance the view by journal deltas; relist on expiry."""
-        try:
-            head = self._cluster.journal_seq()
-            events = self._cluster.events_since(
-                self._last_seq, kind=self._kinds
-            )
-        except ExpiredError:
-            self.sync()
-            return
-        with self._lock:
-            for ev in events:
-                obj = ev.new if ev.new is not None else ev.old
-                if obj is None:
-                    continue
-                meta = obj.get("metadata") or {}
-                key = (
-                    obj.get("kind", ""),
-                    meta.get("namespace", ""),
-                    meta.get("name", ""),
+        """Advance the view by journal deltas; relist on expiry.
+        Serialized — see ``_refresh_serial``."""
+        with self._refresh_serial:
+            try:
+                head = self._cluster.journal_seq()
+                events = self._cluster.events_since(
+                    self._last_seq, kind=self._kinds
                 )
-                if ev.type == "Deleted":
-                    self._snapshot.pop(key, None)
-                else:
-                    self._snapshot[key] = json_copy(obj)
-                self._last_seq = max(self._last_seq, ev.seq)
-            self._last_seq = max(self._last_seq, head)
-            self._last_sync = time.monotonic()
+            except ExpiredError:
+                self.sync()
+                return
+            with self._lock:
+                for ev in events:
+                    obj = ev.new if ev.new is not None else ev.old
+                    if obj is None:
+                        continue
+                    meta = obj.get("metadata") or {}
+                    key = (
+                        obj.get("kind", ""),
+                        meta.get("namespace", ""),
+                        meta.get("name", ""),
+                    )
+                    if self._applied_newer(key, ev.seq):
+                        # Monotonic apply guard: a replayed/duplicated
+                        # frame (held-stream reconnect, sync overlap)
+                        # must never regress an object the view already
+                        # holds at a newer revision — including a stale
+                        # Deleted frame popping a live object (on a
+                        # delete-then-recreate, the recreate's Added
+                        # carries the higher RV, so skipping the stale
+                        # Deleted is the correct order-restored result).
+                        continue
+                    if ev.type == "Deleted":
+                        self._snapshot.pop(key, None)
+                    else:
+                        self._snapshot[key] = json_copy(obj)
+                    self._last_seq = max(self._last_seq, ev.seq)
+                self._last_seq = max(self._last_seq, head)
+                self._last_sync = time.monotonic()
+
+    def _applied_newer(self, key: Key, seq: int) -> bool:
+        """True when the view already holds *key* at a revision >= *seq*
+        (integer RVs — exact on the facade, holds on etcd revisions)."""
+        existing = self._snapshot.get(key)
+        if existing is None:
+            return False
+        try:
+            return int(
+                (existing.get("metadata") or {}).get("resourceVersion") or 0
+            ) >= seq
+        except ValueError:
+            return False
 
     def _maybe_refresh(self) -> None:
         with self._lock:

@@ -1786,15 +1786,23 @@ class TestHeldWatchApiserverRestart:
 class TestCombinedChaosSoak:
     """The capstone e2e: everything that can go wrong, in ONE scenario
     over the real HTTP stack.  Two leader-elected replicas run a
-    CR-driven rollout; mid-flight the apiserver dies and comes back, the
-    policy CR pauses and resumes the rollout, and the leader crashes.
-    The fleet must converge with the throttle budget never exceeded and
-    no node ever riding an undefined transition edge."""
+    CR-driven rollout; mid-flight the apiserver dies and comes back
+    (taking every continue-token snapshot with it), the policy CR
+    pauses and resumes the rollout, an INVALID policy edit is refused
+    at admission, and the leader crashes.  The whole scenario runs with
+    a server-enforced 3-item LIST page cap (every list the operators
+    issue paginates) and the CRDs applied (every policy write passes
+    structural-schema admission).  The fleet must converge with the
+    throttle budget never exceeded and no node ever riding an
+    undefined transition edge."""
 
     def test_soak_apiserver_restart_policy_edit_leader_crash(self):
         from urllib.parse import urlparse
 
+        import yaml
+
         from k8s_operator_libs_tpu.api import UpgradePolicySpec
+        from k8s_operator_libs_tpu.cluster import InvalidError
         from k8s_operator_libs_tpu.controller import (
             CrPolicySource,
             HaOperator,
@@ -1809,6 +1817,12 @@ class TestCombinedChaosSoak:
         from test_resilience import LEGAL_TRANSITIONS, observed_transitions
 
         store = InMemoryCluster()
+        for crd_path in (
+            "hack/crd/bases/tpu.google.com_tpuupgradepolicies.yaml",
+            "hack/crd/bases/maintenance.tpu.google.com_nodemaintenances.yaml",
+        ):
+            with open(crd_path, "r", encoding="utf-8") as fh:
+                store.create(yaml.safe_load(fh))
         store.create(
             {
                 "kind": "TpuUpgradePolicy",
@@ -1825,7 +1839,7 @@ class TestCombinedChaosSoak:
                 },
             }
         )
-        facade = ApiServerFacade(store).start()
+        facade = ApiServerFacade(store, max_list_page=3).start()
         port = urlparse(facade.url).port
 
         def make_replica(identity):
@@ -1896,7 +1910,7 @@ class TestCombinedChaosSoak:
             # store—survives); replicas ride out the outage
             facade.stop()
             time.sleep(0.3)
-            facade = ApiServerFacade(store, port=port).start()
+            facade = ApiServerFacade(store, port=port, max_list_page=3).start()
 
             # ---- phase 3: pause via a live CR edit, then resume
             editor = KubeApiClient(KubeConfig(server=facade.url), timeout=10.0)
@@ -1930,6 +1944,19 @@ class TestCombinedChaosSoak:
                 {"spec": {"autoUpgrade": True}},
                 NAMESPACE,
             )
+
+            # ---- phase 3b: an invalid edit dies at admission (422 over
+            # HTTP) — the CR is untouched and the rollout unaffected
+            with pytest.raises(InvalidError):
+                editor.patch(
+                    "TpuUpgradePolicy",
+                    "fleet-policy",
+                    {"spec": {"maxParallelUpgrades": "garbage"}},
+                    NAMESPACE,
+                )
+            kept = editor.get("TpuUpgradePolicy", "fleet-policy", NAMESPACE)
+            assert kept["spec"]["maxParallelUpgrades"] == 1
+            assert kept["spec"]["autoUpgrade"] is True
 
             # ---- phase 4: crash whichever replica leads now
             deadline = time.monotonic() + 10.0
@@ -1997,7 +2024,11 @@ class TestFlakyApiserverChaos:
         from test_resilience import LEGAL_TRANSITIONS, observed_transitions
 
         store = InMemoryCluster()
-        with ApiServerFacade(store).with_chaos(0.15, seed=7) as facade:
+        # max_list_page: the chaos also hits paginated LISTs mid-drain —
+        # a dropped continue GET must be retried/restarted safely
+        with ApiServerFacade(store, max_list_page=3).with_chaos(
+            0.15, seed=7
+        ) as facade:
             client = KubeApiClient(KubeConfig(server=facade.url), timeout=10.0)
             fleet = Fleet(store)
             for i in range(4):

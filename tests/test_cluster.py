@@ -574,3 +574,120 @@ class TestExampleLabels:
         from k8s_operator_libs_tpu.cluster.selectors import example_labels
 
         assert example_labels("") == {}
+
+
+class TestInformerCacheRefreshRace:
+    """The single-reflector rule (found by the round-4 HTTP bench): on
+    held-stream backends the event queue is pop-once, so two concurrent
+    refreshes would split the stream between threads and apply frames
+    out of order — a node then REGRESSES to an older resourceVersion in
+    the view and cache-visibility waits time out.  Refreshes must
+    serialize, and the apply must be monotonic per object."""
+
+    def test_concurrent_refreshes_serialize(self):
+        import threading as _threading
+
+        from k8s_operator_libs_tpu.cluster import InformerCache
+
+        store = InMemoryCluster()
+        store.create(make_node("n1"))
+        cache = InformerCache(store, lag_seconds=0.005)
+        in_flight = {"now": 0, "max": 0}
+        gate = _threading.Lock()
+        real = store.events_since
+
+        def tracking(seq, kind=None):
+            with gate:
+                in_flight["now"] += 1
+                in_flight["max"] = max(in_flight["max"], in_flight["now"])
+            time.sleep(0.01)  # widen the overlap window
+            try:
+                return real(seq, kind)
+            finally:
+                with gate:
+                    in_flight["now"] -= 1
+
+        store.events_since = tracking
+        try:
+            def hammer():
+                deadline = time.monotonic() + 0.5
+                while time.monotonic() < deadline:
+                    store.patch(
+                        "Node", "n1", {"metadata": {"annotations": {"t": "1"}}}
+                    )
+                    cache.get("Node", "n1")
+
+            threads = [_threading.Thread(target=hammer) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            store.events_since = real
+        assert in_flight["max"] == 1, (
+            f"{in_flight['max']} concurrent journal consumers — the "
+            "held-stream queue would be split between them"
+        )
+
+    def test_replayed_old_frame_does_not_regress_view(self):
+        from k8s_operator_libs_tpu.cluster import InformerCache
+        from k8s_operator_libs_tpu.cluster.inmem import WatchEvent
+
+        store = InMemoryCluster()
+        store.create(make_node("n1"))
+        cache = InformerCache(store, lag_seconds=0.001)
+        for i in range(5):
+            store.patch(
+                "Node", "n1", {"metadata": {"annotations": {"i": str(i)}}}
+            )
+        time.sleep(0.002)
+        fresh = cache.get("Node", "n1")
+        fresh_rv = int(fresh["metadata"]["resourceVersion"])
+        # a held-stream reconnect replays an OLD frame after newer ones
+        old = store.get("Node", "n1")
+        old["metadata"]["resourceVersion"] = "2"
+        old["metadata"]["annotations"] = {"i": "stale"}
+        real = store.events_since
+        store.events_since = lambda seq, kind=None: [
+            WatchEvent(2, "Modified", None, old)
+        ]
+        try:
+            time.sleep(0.002)
+            got = cache.get("Node", "n1")  # triggers a refresh
+        finally:
+            store.events_since = real
+        assert int(got["metadata"]["resourceVersion"]) >= fresh_rv
+        assert got["metadata"]["annotations"].get("i") != "stale"
+
+    def test_stale_deleted_frame_does_not_pop_live_object(self):
+        """The monotonic guard covers Deleted frames too: a replayed
+        stale Deleted must not remove an object the view holds at a
+        newer revision (on delete-then-recreate the recreate's Added
+        carries the higher RV, so skipping the stale Deleted is the
+        order-restored result)."""
+        from k8s_operator_libs_tpu.cluster import InformerCache
+        from k8s_operator_libs_tpu.cluster.inmem import WatchEvent
+
+        store = InMemoryCluster()
+        store.create(make_node("n1"))
+        cache = InformerCache(store, lag_seconds=0.001)
+        for i in range(4):
+            store.patch(
+                "Node", "n1", {"metadata": {"annotations": {"i": str(i)}}}
+            )
+        time.sleep(0.002)
+        live = cache.get("Node", "n1")
+        stale = dict(live)
+        stale["metadata"] = dict(live["metadata"], resourceVersion="1")
+        real = store.events_since
+        store.events_since = lambda seq, kind=None: [
+            WatchEvent(1, "Deleted", stale, None)
+        ]
+        try:
+            time.sleep(0.002)
+            got = cache.get("Node", "n1")  # must NOT raise NotFound
+        finally:
+            store.events_since = real
+        assert got["metadata"]["resourceVersion"] == live["metadata"][
+            "resourceVersion"
+        ]
