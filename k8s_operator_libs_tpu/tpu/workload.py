@@ -56,6 +56,14 @@ class ModelConfig:
     #: no separate toggle).  ``n_experts`` must be divisible by the
     #: mesh's ep factor.  XLA reduces the expert-sharded einsum over ICI.
     n_experts: int = 0
+    #: Long-context attention mode.  False = Megatron SP (activations
+    #: all-gathered for attention — O(seq) attention memory per device).
+    #: True = ring attention (:mod:`.ring_attention`): Q stays
+    #: seq-sharded and K/V blocks rotate the ring via ppermute —
+    #: O(seq/sp) attention memory, ICI-overlapped K/V transfer.  Same
+    #: param tree either way (the flax ``attention_fn`` seam), so the
+    #: two modes are exactly comparable on identical weights.
+    ring_attention: bool = False
 
 
 import threading as _threading
@@ -130,16 +138,52 @@ class Block(nn.Module):
     def __call__(self, x):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_attn")(x)
-        # attention needs the full sequence: gather (XLA all-gather over
-        # the seq axis when sequence parallelism is on)
-        h = _seq_constrain(h, cfg, seq_sharded=False)
-        h = nn.MultiHeadDotProductAttention(
-            num_heads=cfg.n_heads,
-            dtype=cfg.dtype,
-            qkv_features=cfg.d_model,
-            deterministic=True,
-            name="attn",
-        )(h, mask=nn.make_causal_mask(jnp.ones(h.shape[:2], dtype=bool)))
+        ring_mesh = getattr(_seq_sharding_flag, "mesh", None)
+        use_ring = (
+            cfg.ring_attention
+            and cfg.seq_axis is not None
+            and ring_mesh is not None
+            and getattr(_seq_sharding_flag, "on", False)
+        )
+        if use_ring and h.shape[1] % ring_mesh.shape[cfg.seq_axis] != 0:
+            # shard_map needs even seq chunks; an odd length (the
+            # teacher-forcing shift makes seq-1) falls back to the
+            # gather path for THIS shape — shapes are static under jit,
+            # so the choice is a trace-time constant, not control flow.
+            use_ring = False
+        if use_ring:
+            # Ring attention: the sequence STAYS sharded — the qkv
+            # projections are feature-dim ops (fine on seq shards) and
+            # the attention itself rotates K/V blocks over the ring
+            # instead of gathering (causal handled inside; no mask).
+            from .ring_attention import ring_attention_sharded
+
+            h = _seq_constrain(h, cfg, seq_sharded=True)
+
+            def _ring_fn(query, key, value, **_kwargs):
+                return ring_attention_sharded(
+                    query, key, value, ring_mesh, cfg.seq_axis, causal=True
+                )
+
+            h = nn.MultiHeadDotProductAttention(
+                num_heads=cfg.n_heads,
+                dtype=cfg.dtype,
+                qkv_features=cfg.d_model,
+                deterministic=True,
+                attention_fn=_ring_fn,
+                name="attn",
+            )(h)
+        else:
+            # attention needs the full sequence: gather (XLA all-gather
+            # over the seq axis when sequence parallelism is on)
+            h = _seq_constrain(h, cfg, seq_sharded=False)
+            h = nn.MultiHeadDotProductAttention(
+                num_heads=cfg.n_heads,
+                dtype=cfg.dtype,
+                qkv_features=cfg.d_model,
+                deterministic=True,
+                name="attn",
+            )(h, mask=nn.make_causal_mask(jnp.ones(h.shape[:2], dtype=bool)))
         x = x + h
         # elementwise + MLP region: re-shard over the sequence axis
         x = _seq_constrain(x, cfg, seq_sharded=True)
@@ -288,12 +332,14 @@ def make_train_step(model: TinyLM, tx, mesh: Optional[Mesh] = None):
                 tokens, NamedSharding(mesh, P("data", seq))
             )
         _seq_sharding_flag.on = mesh is not None
+        _seq_sharding_flag.mesh = mesh  # ring attention's shard_map mesh
         try:
             loss, grads = jax.value_and_grad(
                 lambda p: loss_fn(model, p, tokens)
             )(params)
         finally:
             _seq_sharding_flag.on = False
+            _seq_sharding_flag.mesh = None
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

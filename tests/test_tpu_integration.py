@@ -431,3 +431,143 @@ class TestTpuSmokeHarness:
 
         out = detect_tpu()  # cpu-pinned here → None
         assert out is None or out["platform"] == "tpu"
+
+
+class TestRingAttention:
+    """Ring attention (tpu/ring_attention.py): sequence-parallel EXACT
+    attention — Q stays sharded, K/V blocks rotate the ring via
+    ppermute with fp32 online-softmax accumulation.  Equivalence to
+    dense attention is the whole claim, so it is pinned at three
+    levels: the raw function (fwd + grads), the flax attention_fn seam
+    inside TinyLM (identical weights, identical loss), and the mesh
+    dryrun (ring step's loss equals the gather-SP step's)."""
+
+    @staticmethod
+    def _jax():
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        return jax, jnp, np, Mesh, NamedSharding, P
+
+    def _qkv(self, b=4, s=32, h=4, d=16, seed=0):
+        _, jnp, np, *_ = self._jax()
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(  # noqa: E731
+            rng.standard_normal((b, s, h, d)), jnp.float32
+        )
+        return mk(), mk(), mk()
+
+    def _mesh(self):
+        jax, _, np, Mesh, *_ = self._jax()
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        return Mesh(devs, axis_names=("data", "seq"))
+
+    def test_forward_matches_dense_reference(self):
+        from k8s_operator_libs_tpu.tpu.ring_attention import (
+            dense_reference,
+            ring_attention_sharded,
+        )
+
+        jax, jnp, np, _, NamedSharding, P = self._jax()
+        mesh = self._mesh()
+        q, k, v = self._qkv()
+        sh = NamedSharding(mesh, P("data", "seq", None, None))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        for causal in (True, False):
+            ref = dense_reference(q, k, v, causal=causal)
+            ring = ring_attention_sharded(qs, ks, vs, mesh, "seq", causal=causal)
+            assert float(jnp.abs(ref - ring).max()) < 1e-5, f"causal={causal}"
+
+    def test_gradients_match_dense_reference(self):
+        from k8s_operator_libs_tpu.tpu.ring_attention import (
+            dense_reference,
+            ring_attention_sharded,
+        )
+
+        jax, jnp, np, _, NamedSharding, P = self._jax()
+        mesh = self._mesh()
+        q, k, v = self._qkv(seed=3)
+        sh = NamedSharding(mesh, P("data", "seq", None, None))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        g_ring = jax.grad(
+            lambda a, b_, c: (
+                ring_attention_sharded(a, b_, c, mesh, "seq") ** 2
+            ).sum(),
+            argnums=(0, 1, 2),
+        )(qs, ks, vs)
+        g_ref = jax.grad(
+            lambda a, b_, c: (dense_reference(a, b_, c) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b_ in zip(g_ring, g_ref):
+            assert float(jnp.abs(a - b_).max()) < 1e-4
+
+    def test_tinylm_ring_equals_gather_on_identical_weights(self):
+        """The flax attention_fn seam keeps the param tree identical, so
+        the two SP modes must produce the same loss and (to optimizer
+        numerics) the same updated params from the same weights."""
+        import dataclasses
+
+        jax, jnp, np, *_ = self._jax()
+        from k8s_operator_libs_tpu.tpu.workload import (
+            ModelConfig,
+            TinyLM,
+            create_train_state,
+            make_batch,
+            make_mesh,
+            make_train_step,
+        )
+
+        # 33 tokens -> 32 after the teacher-forcing shift: divisible
+        # by sp=2, so the ring path REALLY runs (an odd seq falls back
+        # to gather and the comparison would be vacuous)
+        cfg = ModelConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+            d_ff=64, max_seq_len=33, seq_axis="seq",
+        )
+        cfg_ring = dataclasses.replace(cfg, ring_attention=True)
+        mesh = make_mesh(n_devices=8, dp=2, tp=2, sp=2)
+        with mesh:
+            model_g, params, tx, opt = create_train_state(cfg, mesh)
+            step_g = make_train_step(model_g, tx, mesh)
+            step_r = make_train_step(TinyLM(cfg_ring), tx, mesh)
+            batch = make_batch(cfg, 8, seed=0)
+            copy = lambda t: jax.tree.map(jnp.copy, t)  # noqa: E731
+            pg, _, lg = step_g(copy(params), copy(opt), batch)
+            pr, _, lr = step_r(copy(params), copy(opt), batch)
+            assert abs(float(lg) - float(lr)) < 1e-5
+            max_diff = max(
+                jax.tree.leaves(
+                    jax.tree.map(
+                        lambda a, b_: float(jnp.abs(a - b_).max()), pg, pr
+                    )
+                )
+            )
+            assert max_diff < 1e-4
+
+    def test_ring_trains_multiple_steps(self):
+        jax, jnp, np, *_ = self._jax()
+        from k8s_operator_libs_tpu.tpu.workload import (
+            ModelConfig,
+            create_train_state,
+            make_batch,
+            make_mesh,
+            make_train_step,
+        )
+
+        cfg = ModelConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+            d_ff=64, max_seq_len=33, seq_axis="seq", ring_attention=True,
+        )
+        mesh = make_mesh(n_devices=8, dp=2, tp=2, sp=2)
+        with mesh:
+            model, params, tx, opt = create_train_state(cfg, mesh)
+            step = make_train_step(model, tx, mesh)
+            losses = []
+            for i in range(6):
+                params, opt, loss = step(params, opt, make_batch(cfg, 8, seed=i))
+                losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]  # it actually learns
