@@ -40,6 +40,7 @@ STATE_LABEL="tpu.google.com/tpu-runtime-upgrade-state"
 DONE_STATE="upgrade-done"
 NEW_IMAGE="busybox:1.37"
 TIMEOUT_S="${E2E_TIMEOUT_S:-420}"
+POLL_S="${E2E_POLL_S:-5}"
 ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 
 log() { echo "[kind-e2e] $*" >&2; }
@@ -130,7 +131,7 @@ while :; do
      && [ "$ready_pods" -eq "$WORKERS" ] && [ "$cordoned" -eq 0 ]; then
     break
   fi
-  sleep 5
+  sleep "$POLL_S"
 done
 END=$(date +%s)
 ELAPSED=$((END - START))
