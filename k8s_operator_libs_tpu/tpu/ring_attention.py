@@ -146,17 +146,32 @@ def ring_attention_sharded(
     mesh: Mesh,
     seq_axis: str,
     batch_axis: Optional[str] = "data",
+    heads_axis: Optional[str] = None,
     causal: bool = True,
 ):
     """`shard_map` wrapper: global [batch, seq, heads, head_dim] arrays
-    sharded (batch over *batch_axis*, seq over *seq_axis*) → same
-    layout out.  The jit-visible seam for model code."""
-    spec = P(batch_axis, seq_axis, None, None)
+    sharded (batch over *batch_axis*, seq over *seq_axis*, and — when
+    *heads_axis* is given — heads over the tensor-parallel axis) → same
+    layout out.  The jit-visible seam for model code.
+
+    *heads_axis* composes TP with the ring: per-head attention is
+    independent, so each model-group device rings over ITS head subset
+    — without it, entering the shard_map would all-gather q/k/v over
+    the model axis and every tp peer would redo the full-head
+    attention."""
+    try:
+        from jax import shard_map  # jax >= 0.8
+        kw = {"check_vma": False}
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
+        kw = {"check_rep": False}
+
+    spec = P(batch_axis, seq_axis, heads_axis, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
+        **kw,
     )(q, k, v)

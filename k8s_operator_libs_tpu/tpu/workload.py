@@ -66,9 +66,14 @@ class ModelConfig:
     ring_attention: bool = False
 
 
+import logging as _logging
 import threading as _threading
 
 _seq_sharding_flag = _threading.local()
+
+#: (seq_len, sp) combos already warned about — the ring→gather
+#: divisibility fallback is logged once per shape, not per trace.
+_ring_fallback_warned: set = set()
 
 
 def _seq_constrain(x, cfg: "ModelConfig", seq_sharded: bool):
@@ -146,11 +151,26 @@ class Block(nn.Module):
             and getattr(_seq_sharding_flag, "on", False)
         )
         if use_ring and h.shape[1] % ring_mesh.shape[cfg.seq_axis] != 0:
-            # shard_map needs even seq chunks; an odd length (the
-            # teacher-forcing shift makes seq-1) falls back to the
+            # shard_map needs even seq chunks; an indivisible length
+            # (the teacher-forcing shift makes seq-1) falls back to the
             # gather path for THIS shape — shapes are static under jit,
             # so the choice is a trace-time constant, not control flow.
+            # LOUD: the user asked for O(seq/sp) attention memory and is
+            # getting O(seq) — warn once per (seq, sp) combination.
             use_ring = False
+            fallback_key = (h.shape[1], ring_mesh.shape[cfg.seq_axis])
+            if fallback_key not in _ring_fallback_warned:
+                _ring_fallback_warned.add(fallback_key)
+                _logging.getLogger(__name__).warning(
+                    "ring_attention requested but seq length %d is not "
+                    "divisible by the %r mesh axis (size %d); falling "
+                    "back to all-gather attention (O(seq) memory) for "
+                    "this shape — pad/choose a divisible sequence "
+                    "length to get the ring",
+                    h.shape[1],
+                    cfg.seq_axis,
+                    ring_mesh.shape[cfg.seq_axis],
+                )
         if use_ring:
             # Ring attention: the sequence STAYS sharded — the qkv
             # projections are feature-dim ops (fine on seq shards) and
@@ -161,8 +181,24 @@ class Block(nn.Module):
             h = _seq_constrain(h, cfg, seq_sharded=True)
 
             def _ring_fn(query, key, value, **_kwargs):
+                # Compose TP with the ring when the model axis divides
+                # the heads: per-head attention is independent, so each
+                # model-group device rings over its own head subset
+                # (without this, entering the shard_map would gather
+                # q/k/v over the model axis and redo full-head work on
+                # every tp peer).
+                tp = ring_mesh.shape.get("model", 1)
+                heads_axis = (
+                    "model" if tp > 1 and query.shape[2] % tp == 0 else None
+                )
                 return ring_attention_sharded(
-                    query, key, value, ring_mesh, cfg.seq_axis, causal=True
+                    query,
+                    key,
+                    value,
+                    ring_mesh,
+                    cfg.seq_axis,
+                    heads_axis=heads_axis,
+                    causal=True,
                 )
 
             h = nn.MultiHeadDotProductAttention(
