@@ -571,3 +571,100 @@ class TestRingAttention:
                 losses.append(float(loss))
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]  # it actually learns
+
+
+class TestFlashAttention:
+    """Pallas flash kernel (tpu/flash_attention.py) — interpret-mode
+    equivalence on CPU (the compiled kernel is validated on silicon by
+    make tpu-smoke; measured faster than XLA dense from seq ~1k on
+    v5e)."""
+
+    def _qkv(self, b=2, s=256, h=4, d=64, seed=0):
+        _, jnp, np, *_ = TestRingAttention._jax()
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(  # noqa: E731
+            rng.standard_normal((b, s, h, d)), jnp.float32
+        )
+        return mk(), mk(), mk()
+
+    def test_forward_matches_dense(self):
+        jax, jnp, *_ = TestRingAttention._jax()
+        from k8s_operator_libs_tpu.tpu.flash_attention import flash_attention
+        from k8s_operator_libs_tpu.tpu.ring_attention import dense_reference
+
+        q, k, v = self._qkv()
+        for causal in (True, False):
+            ref = dense_reference(q, k, v, causal=causal)
+            out = flash_attention(q, k, v, causal, 128, 128, True)
+            assert float(jnp.abs(ref - out).max()) < 1e-5, f"causal={causal}"
+
+    def test_uneven_q_k_blocks(self):
+        """block_q != block_k exercises the ceil-divided causal loop
+        bound (the diagonal block can straddle k-blocks)."""
+        jax, jnp, *_ = TestRingAttention._jax()
+        from k8s_operator_libs_tpu.tpu.flash_attention import flash_attention
+        from k8s_operator_libs_tpu.tpu.ring_attention import dense_reference
+
+        q, k, v = self._qkv(s=256)
+        ref = dense_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, True, 64, 128, True)
+        assert float(jnp.abs(ref - out).max()) < 1e-5
+        out2 = flash_attention(q, k, v, True, 128, 64, True)
+        assert float(jnp.abs(ref - out2).max()) < 1e-5
+
+    def test_gradients_via_recompute_backward(self):
+        jax, jnp, *_ = TestRingAttention._jax()
+        from k8s_operator_libs_tpu.tpu.flash_attention import flash_attention
+        from k8s_operator_libs_tpu.tpu.ring_attention import dense_reference
+
+        q, k, v = self._qkv(s=128, seed=2)
+        gf = jax.grad(
+            lambda a, b_, c: (
+                flash_attention(a, b_, c, True, 64, 64, True) ** 2
+            ).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gr = jax.grad(
+            lambda a, b_, c: (dense_reference(a, b_, c) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b_ in zip(gf, gr):
+            assert float(jnp.abs(a - b_).max()) < 1e-4
+
+    def test_indivisible_seq_rejected(self):
+        import pytest as _pytest
+
+        from k8s_operator_libs_tpu.tpu.flash_attention import flash_attention
+
+        q, k, v = self._qkv(s=200)
+        with _pytest.raises(ValueError):
+            flash_attention(q, k, v, True, 128, 128, True)
+
+    def test_tinylm_flash_equals_gather_on_identical_weights(self):
+        """Same attention_fn seam as ring: identical param tree, so the
+        flash model must match the gather model's loss on the same
+        weights (interpret mode on CPU)."""
+        import dataclasses
+
+        jax, jnp, np, *_ = TestRingAttention._jax()
+        from k8s_operator_libs_tpu.tpu.workload import (
+            ModelConfig,
+            TinyLM,
+            create_train_state,
+            make_batch,
+            make_train_step,
+        )
+
+        cfg = ModelConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+            d_ff=64, max_seq_len=33,
+        )
+        cfg_flash = dataclasses.replace(cfg, flash_attention=True)
+        model_g, params, tx, opt = create_train_state(cfg)
+        step_g = make_train_step(model_g, tx)
+        step_f = make_train_step(TinyLM(cfg_flash), tx)
+        batch = make_batch(cfg, 4, seed=0)
+        copy = lambda t: jax.tree.map(jnp.copy, t)  # noqa: E731
+        _, _, lg = step_g(copy(params), copy(opt), batch)
+        _, _, lf = step_f(copy(params), copy(opt), batch)
+        assert abs(float(lg) - float(lf)) < 1e-4
