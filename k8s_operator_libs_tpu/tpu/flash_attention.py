@@ -36,9 +36,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .ring_attention import dense_reference
-
-_NEG = -1e30
+from .ring_attention import _NEG, dense_reference
 
 
 def _flash_kernel(
@@ -221,7 +219,10 @@ def make_flash_attention_fn(
 
     def attention_fn(query, key, value, **_kwargs):
         s = query.shape[1]
-        pad = (-s) % min(block, s)
+        # pad up to a multiple of the FULL block size: a short remainder
+        # block (e.g. seq 127 with block 128) would hand Mosaic a
+        # non-tile-aligned block shape on real TPU
+        pad = (-s) % block
         if pad:
             widths = ((0, 0), (0, pad), (0, 0), (0, 0))
             query = jnp.pad(query, widths)

@@ -224,6 +224,16 @@ class Block(nn.Module):
 
             attention_fn = make_flash_attention_fn()
         else:
+            if cfg.flash_attention:
+                # same loudness as the ring divisibility fallback above:
+                # never let a timing run attribute gather numbers to the
+                # flash kernel
+                _logging.getLogger(__name__).warning(
+                    "flash_attention=True but sequence sharding is "
+                    "active: the per-chip flash kernel needs the full "
+                    "sequence — falling back to all-gather attention "
+                    "(use ring_attention for the sharded path)"
+                )
             # attention needs the full sequence: gather (XLA all-gather
             # over the seq axis when sequence parallelism is on)
             h = _seq_constrain(h, cfg, seq_sharded=False)

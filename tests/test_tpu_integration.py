@@ -640,6 +640,25 @@ class TestFlashAttention:
         with _pytest.raises(ValueError):
             flash_attention(q, k, v, True, 128, 128, True)
 
+    def test_attention_fn_pads_indivisible_seq_to_full_block(self):
+        """The flax seam pads ANY indivisible sequence up to a multiple
+        of the full block (even seq < block: a short remainder block
+        like 127 would be a non-tile-aligned Mosaic shape on silicon),
+        and the sliced-back result is exact vs dense."""
+        jax, jnp, *_ = TestRingAttention._jax()
+        from k8s_operator_libs_tpu.tpu.flash_attention import (
+            make_flash_attention_fn,
+        )
+        from k8s_operator_libs_tpu.tpu.ring_attention import dense_reference
+
+        fn = make_flash_attention_fn(interpret=True, block=128)
+        for s in (127, 255):
+            q, k, v = self._qkv(s=s, seed=s)
+            out = fn(q, k, v)
+            ref = dense_reference(q, k, v, causal=True)
+            assert out.shape == ref.shape
+            assert float(jnp.abs(out - ref).max()) < 1e-5, f"seq={s}"
+
     def test_tinylm_flash_equals_gather_on_identical_weights(self):
         """Same attention_fn seam as ring: identical param tree, so the
         flash model must match the gather model's loss on the same
