@@ -187,6 +187,33 @@ class InformerCache:
                 raise NotFoundError(f"{kind} {namespace}/{name} not in cache")
             return json_copy(obj)
 
+    def resource_version_of(
+        self, kind: str, name: str, namespace: str = ""
+    ) -> Optional[str]:
+        """The cached object's resourceVersion WITHOUT copying the
+        object — the write-visibility wait
+        (NodeUpgradeStateProvider._cache_caught_up) polls this once per
+        write per poll interval; full copies per poll are pure overhead
+        at fleet scale.  None when the object is not (yet) visible."""
+        self._check_kind(kind)
+        if self.lag_seconds <= 0:
+            peek = getattr(self._cluster, "resource_version_of", None)
+            if peek is not None:
+                return peek(kind, name, namespace)
+            try:
+                obj = self._cluster.get(kind, name, namespace)
+            except NotFoundError:
+                return None
+            rv = (obj.get("metadata") or {}).get("resourceVersion")
+            return rv if isinstance(rv, str) else None
+        self._maybe_refresh()
+        with self._lock:
+            obj = self._snapshot.get((kind, namespace, name))
+            if obj is None:
+                return None
+            rv = (obj.get("metadata") or {}).get("resourceVersion")
+            return rv if isinstance(rv, str) else None
+
     def list(
         self, kind: str, namespace: Optional[str] = None, label_selector: str = ""
     ) -> List[JsonObj]:

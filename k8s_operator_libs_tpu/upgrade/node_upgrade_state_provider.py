@@ -245,7 +245,19 @@ class NodeUpgradeStateProvider:
     # ------------------------------------------------------------- internals
     def _cache_caught_up(self, name: str, rv: int) -> bool:
         """True when the cache serves this node at resourceVersion >= *rv*
-        (a later write advancing past ours also counts as caught up)."""
+        (a later write advancing past ours also counts as caught up).
+        Prefers the cache's copy-free rv probe — this runs once per
+        write per poll tick, and a deep copy per tick serializes every
+        reader on the backing store's lock at fleet scale."""
+        peek = getattr(self._cache, "resource_version_of", None)
+        if peek is not None:
+            cached_rv = peek("Node", name)
+            if cached_rv is None:
+                return False
+            try:
+                return int(cached_rv) >= rv
+            except (TypeError, ValueError):
+                return False
         try:
             cached = self._cache.get("Node", name)
         except NotFoundError:

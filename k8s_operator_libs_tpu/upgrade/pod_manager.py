@@ -19,6 +19,7 @@ Reference parity: ``pkg/upgrade/pod_manager.go`` (C5) —
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor, wait as futures_wait
 from dataclasses import dataclass, field
@@ -93,6 +94,17 @@ class PodManager:
         self._check_pool = ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="pod-check"
         )
+        # DS-revision oracle memo, keyed (uid, resourceVersion), cleared
+        # by the state manager at the top of every reconcile cycle
+        # (reset_revision_memo).  Without it the loop re-lists
+        # ControllerRevisions once per NODE per cycle — the dominant
+        # read at fleet scale.  Per-cycle clearing (not trust in the rv
+        # key alone) is load-bearing: between a DS template edit and the
+        # controller cutting the new ControllerRevision, a lookup would
+        # otherwise cache the OLD newest revision against the NEW rv and
+        # never heal.
+        self._ds_hash_memo: dict = {}
+        self._ds_hash_lock = threading.Lock()
 
     def shutdown(self, wait: bool = True) -> None:
         """Release worker threads.  Embedders running short-lived managers
@@ -120,10 +132,26 @@ class PodManager:
             )
         return hash_
 
+    def reset_revision_memo(self) -> None:
+        """Drop the per-cycle DS-revision memo (called by the state
+        manager before each BuildState so every cycle observes fresh
+        ControllerRevisions exactly once)."""
+        with self._ds_hash_lock:
+            self._ds_hash_memo.clear()
+
     def get_daemonset_controller_revision_hash(self, daemonset: JsonObj) -> str:
         """Newest ControllerRevision owned by the DaemonSet (reference:
         GetDaemonsetControllerRevisionHash, pod_manager.go:92-119 — sorts by
-        .revision, takes the highest, strips the name prefix)."""
+        .revision, takes the highest, strips the name prefix).  Memoized
+        per (uid, resourceVersion) within a reconcile cycle — see
+        ``reset_revision_memo``."""
+        meta = daemonset.get("metadata") or {}
+        memo_key = (meta.get("uid", ""), meta.get("resourceVersion", ""))
+        if all(memo_key):
+            with self._ds_hash_lock:
+                hit = self._ds_hash_memo.get(memo_key)
+            if hit is not None:
+                return hit
         ds_name = name_of(daemonset)
         # Ownership is the authoritative filter; the name-prefix fallback is
         # only for revisions that carry no ownerReferences at all (e.g.
@@ -147,7 +175,13 @@ class PodManager:
         newest = max(revisions, key=lambda cr: cr.get("revision", 0))
         cr_name = name_of(newest)
         prefix = f"{ds_name}-"
-        return cr_name[len(prefix):] if cr_name.startswith(prefix) else cr_name
+        result = cr_name[len(prefix):] if cr_name.startswith(prefix) else cr_name
+        if all(memo_key):
+            with self._ds_hash_lock:
+                if len(self._ds_hash_memo) > 256:  # unreset-embedder bound
+                    self._ds_hash_memo.clear()
+                self._ds_hash_memo[memo_key] = result
+        return result
 
     # -------------------------------------------------------------- eviction
     def schedule_pod_eviction(self, config: PodManagerConfig) -> None:

@@ -257,6 +257,9 @@ class ClusterUpgradeStateManager:
         self, namespace: str, driver_labels: Dict[str, str]
     ) -> ClusterUpgradeState:
         common = self.common
+        # fresh cycle: the DS-revision oracle re-reads ControllerRevisions
+        # once, then every per-node sync check this cycle hits the memo
+        self.pod_manager.reset_revision_memo()
         state = ClusterUpgradeState()
         daemon_sets = common.get_driver_daemon_sets(namespace, driver_labels)
         pods = self._cluster.list(
