@@ -1,4 +1,4 @@
-"""Flash attention — a Pallas TPU kernel for the per-chip hot path.
+"""Flash attention — Pallas TPU kernels for the per-chip hot path.
 
 The attention story in this repo has three tiers:
 
@@ -9,23 +9,28 @@ The attention story in this repo has three tiers:
   K/V blocks rotate the ICI ring;
 * **flash** (this module): the per-chip kernel — never materializes
   the [seq, seq] score matrix AND never holds more than one K/V block
-  in VMEM.  The grid is (batch*heads, q-blocks, k-blocks) with the
-  k axis innermost: each program folds one [block_k, d] K/V tile into
-  fp32 online-softmax accumulators living in VMEM scratch, which TPU
-  grid semantics persist across the sequential k steps; the final k
-  step writes the normalized output tile.  Causal q/k block pairs
-  strictly above the diagonal skip their compute via ``pl.when``.
+  in VMEM.  The forward grid is (batch*heads, q-blocks, k-blocks) with
+  the k axis innermost: each program folds one [block_k, d] K/V tile
+  into fp32 online-softmax accumulators living in VMEM scratch, which
+  TPU grid semantics persist across the sequential k steps; the final
+  k step writes the normalized output tile plus the per-row logsumexp
+  (the backward residual).  Causal q/k block pairs strictly above the
+  diagonal skip their compute via ``pl.when``.
 
-Autodiff: ``pl.pallas_call`` is not differentiable, so
-:func:`flash_attention` carries a ``jax.custom_vjp`` whose backward
-RECOMPUTES dense attention and takes its VJP — the forward pass gets
-the kernel (the inference/serving hot path and the timed half of
-training steps); a fused backward kernel is the known next step.
+Autodiff: ``jax.custom_vjp`` with a FUSED Pallas backward by default —
+two kernels re-derive the probability tiles from the saved logsumexp
+(never materializing [seq, seq]): one accumulates dQ with k innermost,
+the other accumulates dK/dV with q innermost; the row term
+D = rowsum(dO ∘ O) is a cheap XLA elementwise reduction outside the
+kernels.  So long-context TRAINING stays O(seq) memory — on a 16 GB
+v5e chip the dense score matrix alone is 16 GB at seq 8k (b=4, h=8,
+fp32), which OOMs before the first step, while the flash path runs.
+``backward="recompute"`` keeps the previous dense-recompute VJP as a
+debugging fallback.
 
 Tested in interpret mode on CPU against the dense reference
 (tests/test_tpu_integration.py::TestFlashAttention) and compiled on
-real TPU silicon by ``make tpu-smoke`` / bench's ``tpu`` section
-(measured faster than XLA dense attention from seq ~1k on v5e).
+real TPU silicon by ``make tpu-smoke`` / bench's ``tpu`` section.
 """
 
 from __future__ import annotations
@@ -39,11 +44,29 @@ import jax.numpy as jnp
 from .ring_attention import _NEG, dense_reference
 
 
+def _causal_needed(qi, kj, block_q: int, block_k: int):
+    """True when q-tile *qi* has at least one row at or below the
+    diagonal of k-tile *kj* (the block pair contributes under the
+    causal mask)."""
+    return kj * block_k <= qi * block_q + (block_q - 1)
+
+
+def _causal_mask(qi, kj, block_q: int, block_k: int):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return q_pos >= k_pos
+
+
 def _flash_kernel(
     q_ref,
     k_ref,
     v_ref,
     o_ref,
+    lse_ref,
     acc_ref,
     m_ref,
     l_ref,
@@ -56,7 +79,7 @@ def _flash_kernel(
     """One (bh, qi, kj) grid step: fold K/V tile kj into the online
     accumulator for q tile qi.  Scratch (acc, m, l) persists across the
     sequential kj steps; kj == 0 initializes, the last kj normalizes
-    and writes the output tile."""
+    and writes the output tile and its logsumexp row."""
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -71,9 +94,7 @@ def _flash_kernel(
 
     # Causal: blocks strictly above the diagonal contribute nothing —
     # skip their MXU work (their K/V tiles still ride the grid DMA).
-    needed = (
-        kj * block_k <= qi * block_q + (block_q - 1) if causal else True
-    )
+    needed = _causal_needed(qi, kj, block_q, block_k) if causal else True
 
     @pl.when(needed)
     def _update():
@@ -82,13 +103,7 @@ def _flash_kernel(
         v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, _NEG)
+            s = jnp.where(_causal_mask(qi, kj, block_q, block_k), s, _NEG)
         m_prev = m_ref[...]  # [BQ, 1]
         l_prev = l_ref[...]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -103,19 +118,10 @@ def _flash_kernel(
     @pl.when(kj == n_k - 1)
     def _finalize():
         o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[..., 0] + jnp.log(l_ref[..., 0])
 
 
-def _flash_forward(
-    q, k, v, causal: bool, block_q: int, block_k: int, interpret: bool
-):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    b, s, h, d = q.shape
-    scale = 1.0 / (d ** 0.5)
-    # fold batch x heads into one grid axis; layout [BH, S, D]
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)  # noqa: E731
-    qf, kf, vf = fold(q), fold(k), fold(v)
+def _check_blocks(s: int, block_q: int, block_k: int) -> tuple:
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q or s % block_k:
@@ -124,6 +130,22 @@ def _flash_forward(
             f"({block_q}) and block_k ({block_k}); pad the sequence "
             f"(make_flash_attention_fn does this for the causal case)"
         )
+    return block_q, block_k
+
+
+def _flash_forward(
+    q, k, v, causal: bool, block_q: int, block_k: int, interpret: bool
+):
+    """Returns (out [b,s,h,d], lse [b*h, s] fp32)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    # fold batch x heads into one grid axis; layout [BH, S, D]
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)  # noqa: E731
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    block_q, block_k = _check_blocks(s, block_q, block_k)
     kernel = functools.partial(
         _flash_kernel,
         block_q=block_q,
@@ -131,7 +153,7 @@ def _flash_forward(
         causal=causal,
         scale=scale,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         # k innermost: sequential on TPU, so the VMEM scratch carries
         # the accumulator across k steps of one q tile
@@ -153,12 +175,22 @@ def _flash_forward(
                 memory_space=pltpu.VMEM,
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, d),
-            lambda bh, qi, kj: (bh, qi, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec(
+                (1, block_q, d),
+                lambda bh, qi, kj: (bh, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_q),
+                lambda bh, qi, kj: (bh, qi),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),  # acc
             pltpu.VMEM((block_q, 1), jnp.float32),  # m
@@ -166,10 +198,250 @@ def _flash_forward(
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bwd_dq_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    dvec_ref,
+    dq_ref,
+    acc_ref,
+    *,
+    block_q: int,
+    block_k: int,
+    causal: bool,
+    scale: float,
+):
+    """Grid (bh, qi, kj), k innermost: accumulate dQ for q tile qi by
+    re-deriving each probability tile from the saved logsumexp."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    needed = _causal_needed(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(needed)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = (
+            jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        )  # [BQ, BK]
+        p = jnp.exp(s - lse_ref[0][:, None])
+        if causal:
+            p = jnp.where(_causal_mask(qi, kj, block_q, block_k), p, 0.0)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dvec_ref[0][:, None]) * scale
+        acc_ref[...] += jnp.dot(
+            ds, k_blk, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    dvec_ref,
+    dk_ref,
+    dv_ref,
+    dk_acc_ref,
+    dv_acc_ref,
+    *,
+    block_q: int,
+    block_k: int,
+    causal: bool,
+    scale: float,
+):
+    """Grid (bh, kj, qi), q innermost: accumulate dK and dV for k tile
+    kj across the q tiles that attend to it."""
+    from jax.experimental import pallas as pl
+
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    needed = _causal_needed(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(needed)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = (
+            jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        )  # [BQ, BK]
+        p = jnp.exp(s - lse_ref[0][:, None])
+        if causal:
+            p = jnp.where(_causal_mask(qi, kj, block_q, block_k), p, 0.0)
+        dv_acc_ref[...] += jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32
+        )
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dvec_ref[0][:, None]) * scale
+        dk_acc_ref[...] += jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, o, lse, g, causal: bool, block_q: int, block_k: int,
+    interpret: bool,
+):
+    """Fused flash backward: (dq, dk, dv) with O(seq) memory."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)  # noqa: E731
+    qf, kf, vf, dof = fold(q), fold(k), fold(v), fold(g)
+    block_q, block_k = _check_blocks(s, block_q, block_k)
+    # D_i = sum_j P_ij dP_ij = rowsum(dO ∘ O): a cheap XLA elementwise
+    # reduction — no reason to burn kernel VMEM on it
+    dvec = (fold(o).astype(jnp.float32) * dof.astype(jnp.float32)).sum(-1)
+
+    common = dict(
+        block_q=block_q, block_k=block_k, causal=causal, scale=scale
+    )
+    # ---- dQ: grid (bh, qi, kj), k innermost ----
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(b * h, s // block_q, s // block_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, d),
+                lambda bh, qi, kj: (bh, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda bh, qi, kj: (bh, kj, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda bh, qi, kj: (bh, kj, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_q, d),
+                lambda bh, qi, kj: (bh, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_q),
+                lambda bh, qi, kj: (bh, qi),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_q),
+                lambda bh, qi, kj: (bh, qi),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d),
+            lambda bh, qi, kj: (bh, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, dvec)
+
+    # ---- dK/dV: grid (bh, kj, qi), q innermost ----
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(b * h, s // block_k, s // block_q),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, d),
+                lambda bh, kj, qi: (bh, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda bh, kj, qi: (bh, kj, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda bh, kj, qi: (bh, kj, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_q, d),
+                lambda bh, kj, qi: (bh, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_q),
+                lambda bh, kj, qi: (bh, qi),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_q),
+                lambda bh, kj, qi: (bh, qi),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda bh, kj, qi: (bh, kj, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d),
+                lambda bh, kj, qi: (bh, kj, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, dvec)
+
+    unfold = lambda x: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)  # noqa: E731
+    return unfold(dq), unfold(dk), unfold(dv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q,
     k,
@@ -178,25 +450,35 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    backward: str = "fused",
 ):
     """Pallas flash attention.  Shapes [batch, seq, heads, head_dim];
-    returns the same.  ``interpret=True`` runs the kernel in the Pallas
-    interpreter (CPU tests); on TPU leave it False for the compiled
-    kernel.  Differentiable via a dense-recompute backward (module
-    docstring)."""
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    returns the same.  ``interpret=True`` runs the kernels in the
+    Pallas interpreter (CPU tests); on TPU leave it False.
+    Differentiable: ``backward="fused"`` (default) runs the Pallas
+    backward kernels (O(seq) memory); ``"recompute"`` falls back to
+    differentiating dense attention (O(seq^2) — debugging only)."""
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, backward):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    # dense recompute: numerically the same attention, XLA-differentiated
-    _, vjp = jax.vjp(lambda a, b, c: dense_reference(a, b, c, causal), q, k, v)
-    return vjp(g)
+def _flash_bwd(causal, block_q, block_k, interpret, backward, residuals, g):
+    q, k, v, o, lse = residuals
+    if backward == "recompute":
+        # dense recompute: numerically the same attention,
+        # XLA-differentiated — materializes [seq, seq]
+        _, vjp = jax.vjp(
+            lambda a, b, c: dense_reference(a, b, c, causal), q, k, v
+        )
+        return vjp(g)
+    return _flash_backward(
+        q, k, v, o, lse, g, causal, block_q, block_k, interpret
+    )
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

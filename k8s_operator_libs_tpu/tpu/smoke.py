@@ -85,6 +85,34 @@ def _attention_bench(iters: int = 30) -> Dict[str, Any]:
             "dense_ms": round(times["dense"], 3),
             "speedup": round(times["dense"] / times["flash"], 3),
         }
+
+    # Long context: a TRAINING step (fwd + fused Pallas bwd) at seq 8k.
+    # Dense attention cannot run here at all — the fp32 score matrix
+    # alone is b*h*s^2*4 = 8 GiB and XLA needs two such temps, which
+    # exceeds a 16 GB v5e before the first step — so flash-only, and
+    # the dense column records the arithmetic instead of an OOM crash.
+    s = 8192
+    mk8 = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((b, s, h, d)), jnp.bfloat16
+    )
+    q, k, v = mk8(), mk8(), mk8()
+    step = jax.jit(
+        jax.grad(
+            lambda a, x, c: flash_attention(a, x, c, True, 128, 128, False)
+            .astype(jnp.float32)
+            .sum(),
+            argnums=(0, 1, 2),
+        )
+    )
+    jax.block_until_ready(step(q, k, v))  # compile
+    t0 = time.perf_counter()
+    for _ in range(5):
+        r = step(q, k, v)
+    jax.block_until_ready(r)
+    out["seq_8192_train"] = {
+        "flash_fwd_bwd_ms": round((time.perf_counter() - t0) / 5 * 1e3, 3),
+        "dense": "unrunnable: fp32 score temps = 2 x 8 GiB > 16 GB HBM",
+    }
     return out
 
 

@@ -612,23 +612,48 @@ class TestFlashAttention:
         out2 = flash_attention(q, k, v, True, 128, 64, True)
         assert float(jnp.abs(ref - out2).max()) < 1e-5
 
-    def test_gradients_via_recompute_backward(self):
+    def test_gradients_fused_backward_matches_dense(self):
+        """The default backward is the FUSED Pallas kernel pair (dQ
+        k-innermost, dK/dV q-innermost; O(seq) memory) — it must match
+        the XLA-differentiated dense reference, causal and not, and
+        with uneven q/k blocks (diagonal straddling in both grids)."""
         jax, jnp, *_ = TestRingAttention._jax()
         from k8s_operator_libs_tpu.tpu.flash_attention import flash_attention
         from k8s_operator_libs_tpu.tpu.ring_attention import dense_reference
 
         q, k, v = self._qkv(s=128, seed=2)
-        gf = jax.grad(
+        for causal in (True, False):
+            for bq, bk in ((64, 64), (32, 64), (64, 32)):
+                gf = jax.grad(
+                    lambda a, b_, c: (
+                        flash_attention(a, b_, c, causal, bq, bk, True) ** 2
+                    ).sum(),
+                    argnums=(0, 1, 2),
+                )(q, k, v)
+                gr = jax.grad(
+                    lambda a, b_, c: (
+                        dense_reference(a, b_, c, causal) ** 2
+                    ).sum(),
+                    argnums=(0, 1, 2),
+                )(q, k, v)
+                for a, b_ in zip(gf, gr):
+                    err = float(jnp.abs(a - b_).max())
+                    assert err < 1e-4, (causal, bq, bk, err)
+
+    def test_gradients_recompute_backward_fallback(self):
+        """backward="recompute" (the debugging fallback) differentiates
+        dense attention and must agree with the fused default."""
+        jax, jnp, *_ = TestRingAttention._jax()
+        from k8s_operator_libs_tpu.tpu.flash_attention import flash_attention
+
+        q, k, v = self._qkv(s=128, seed=3)
+        loss = lambda mode: jax.grad(  # noqa: E731
             lambda a, b_, c: (
-                flash_attention(a, b_, c, True, 64, 64, True) ** 2
+                flash_attention(a, b_, c, True, 64, 64, True, mode) ** 2
             ).sum(),
             argnums=(0, 1, 2),
         )(q, k, v)
-        gr = jax.grad(
-            lambda a, b_, c: (dense_reference(a, b_, c) ** 2).sum(),
-            argnums=(0, 1, 2),
-        )(q, k, v)
-        for a, b_ in zip(gf, gr):
+        for a, b_ in zip(loss("fused"), loss("recompute")):
             assert float(jnp.abs(a - b_).max()) < 1e-4
 
     def test_indivisible_seq_rejected(self):
