@@ -54,11 +54,13 @@ def run_real(args) -> int:
 
     util.set_component_name(args.component)
     if args.in_cluster:
-        client = KubeApiClient(KubeConfig.in_cluster())
+        config = KubeConfig.in_cluster()
     else:
-        client = KubeApiClient(
-            KubeConfig.load(args.kubeconfig or None, context=args.context)
-        )
+        config = KubeConfig.load(args.kubeconfig or None, context=args.context)
+    # client-side throttle: controller-runtime's rest.Config defaults
+    config.qps = args.qps
+    config.burst = args.burst
+    client = KubeApiClient(config)
     recorder = util.ClusterEventRecorder(client, namespace=args.namespace)
     manager = ClusterUpgradeStateManager(client, recorder=recorder)
     labels = {}
@@ -196,6 +198,19 @@ def main() -> int:
         help="campaign identity for --ha (default: hostname-pid)",
     )
     parser.add_argument("--resync-seconds", type=float, default=30.0)
+    parser.add_argument(
+        "--qps",
+        type=float,
+        default=20.0,
+        help="client-side request rate cap (controller-runtime's "
+        "rest.Config default; 0 disables throttling)",
+    )
+    parser.add_argument(
+        "--burst",
+        type=int,
+        default=30,
+        help="client-side burst size above --qps",
+    )
     parser.add_argument(
         "--ops-port",
         type=int,
