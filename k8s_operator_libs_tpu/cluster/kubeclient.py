@@ -1324,6 +1324,32 @@ class KubeApiClient:
     parse_selector = staticmethod(parse_selector)
 
 
+class _ReconnectBackoff:
+    """client-go reflector retry pacing: exponential backoff with full
+    jitter, reset on a healthy stream.  A fixed retry interval against
+    a down apiserver is a reconnect storm multiplied by every watcher
+    in the fleet; jitter de-synchronizes them."""
+
+    def __init__(
+        self, base: float = 0.2, factor: float = 2.0, cap: float = 30.0
+    ) -> None:
+        import random
+
+        self._base = base
+        self._factor = factor
+        self._cap = cap
+        self._current = base
+        self._rng = random.Random()
+
+    def next(self) -> float:
+        delay = self._current * (0.5 + self._rng.random() * 0.5)
+        self._current = min(self._current * self._factor, self._cap)
+        return delay
+
+    def reset(self) -> None:
+        self._current = self._base
+
+
 class _HeldWatcher(threading.Thread):
     """One kind's held watch stream: a dedicated connection holds a long
     watch, frames are ingested as the server pushes them, reconnecting
@@ -1335,6 +1361,7 @@ class _HeldWatcher(threading.Thread):
         self._client = client
         self._kind = kind
         self._hold = hold_seconds
+        self._backoff = _ReconnectBackoff()
         self._stop_event = threading.Event()
         self._conn = None
         #: The raw socket, captured at request time — getresponse()
@@ -1371,6 +1398,9 @@ class _HeldWatcher(threading.Thread):
                     metrics.record_watch_reconnect(self._kind)
                 first = False
                 self._run_stream()
+                # a stream that held to its natural expiry means the
+                # server is healthy: next failure starts from scratch
+                self._backoff.reset()
             except ExpiredError:
                 metrics.record_watch_expired(self._kind)
                 self._client._reset_kind_state(self._kind)
@@ -1397,16 +1427,19 @@ class _HeldWatcher(threading.Thread):
                         "held watch %s: 401 with no credential plugin",
                         self._kind,
                     )
-                self._stop_event.wait(0.2)
+                self._stop_event.wait(max(0.2, self._backoff.next()))
             except Exception as err:  # noqa: BLE001 — thread boundary
                 if self._stop_event.is_set():
                     return
+                delay = self._backoff.next()
                 logger.debug(
-                    "held watch %s: stream error (%s); reconnecting",
+                    "held watch %s: stream error (%s); reconnecting in "
+                    "%.2fs",
                     self._kind,
                     err,
+                    delay,
                 )
-                self._stop_event.wait(0.2)
+                self._stop_event.wait(delay)
 
     def _open_connection(self):
         client = self._client

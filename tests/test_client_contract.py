@@ -2718,3 +2718,30 @@ class TestClientSideThrottle:
         assert not errors
         # 16 requests, 4 burst, 40/s refill => >= 0.3 s
         assert elapsed >= 0.25, f"bucket not shared ({elapsed:.3f}s)"
+
+
+class TestReconnectBackoff:
+    """Held-watch retry pacing (client-go reflector parity): failures
+    back off exponentially with full jitter; a healthy stream resets."""
+
+    def test_grows_to_cap_with_jitter(self):
+        from k8s_operator_libs_tpu.cluster.kubeclient import _ReconnectBackoff
+
+        b = _ReconnectBackoff(base=0.2, factor=2.0, cap=30.0)
+        delays = [b.next() for _ in range(12)]
+        # each delay jitters in [0.5, 1.0] x the current interval
+        expected = 0.2
+        for d in delays:
+            assert expected * 0.5 <= d <= expected
+            expected = min(expected * 2.0, 30.0)
+        # late retries sit at the cap's jitter window, not beyond
+        assert delays[-1] <= 30.0
+
+    def test_reset_restarts_from_base(self):
+        from k8s_operator_libs_tpu.cluster.kubeclient import _ReconnectBackoff
+
+        b = _ReconnectBackoff(base=0.2, factor=2.0, cap=30.0)
+        for _ in range(6):
+            b.next()
+        b.reset()
+        assert b.next() <= 0.2
