@@ -113,6 +113,11 @@ class _Handler(BaseHTTPRequestHandler):
     accepted_tokens: Optional[set] = None
     #: >0 = server-enforced LIST page cap (see ApiServerFacade).
     max_list_page: int = 0
+    #: >0 = priority-and-fairness max-in-flight: requests beyond this
+    #: many concurrent non-watch requests are rejected 429 with
+    #: Retry-After and the APF flow-schema header (see ApiServerFacade).
+    apf_max_inflight: int = 0
+    apf_state: Optional[dict] = None
 
     def _check_auth(self) -> None:
         if self.accepted_tokens is None:
@@ -176,14 +181,53 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             self._check_auth()
             (info, namespace, name, subresource), query = self._route()
-            handler = getattr(self, f"_handle_{method}")
-            handler(info, namespace, name, subresource, query)
+            # Priority-and-fairness max-in-flight: a real apiserver sheds
+            # load with 429 + Retry-After + the flow-schema header BEFORE
+            # processing.  Long-held watch streams are exempt (APF seats
+            # them once at admission, not for their whole hold).
+            apf = self.apf_state
+            gated = (
+                apf is not None
+                and self.apf_max_inflight > 0
+                and query.get("watch") != "true"
+            )
+            if gated:
+                with apf["lock"]:
+                    if apf["active"] >= self.apf_max_inflight:
+                        apf["rejected"] += 1
+                        self._send_overload()
+                        return
+                    apf["active"] += 1
+            try:
+                handler = getattr(self, f"_handle_{method}")
+                handler(info, namespace, name, subresource, query)
+            finally:
+                if gated:
+                    with apf["lock"]:
+                        apf["active"] -= 1
         except ApiError as err:
             self._send_error_status(err)
         except Exception as err:  # noqa: BLE001 — server boundary
             logger.exception("facade: internal error")
             internal = ApiError(str(err))
             self._send_error_status(internal)
+
+    def _send_overload(self) -> None:
+        err = TooManyRequestsError(
+            "too many requests, please try again later"
+        )
+        data = json.dumps(_status_body(err)).encode()
+        self.send_response(429)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Retry-After", "1")
+        # what marks this 429 as APF load shedding (vs an Eviction's
+        # PDB-driven 429, which carries no such header)
+        self.send_header(
+            "X-Kubernetes-PF-FlowSchema-UID", "facade-max-inflight"
+        )
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def do_GET(self) -> None:  # noqa: N802
         self._dispatch("get")
@@ -475,11 +519,19 @@ class ApiServerFacade:
         port: int = 0,
         accepted_tokens: Optional[set] = None,
         max_list_page: int = 0,
+        max_inflight: int = 0,
     ) -> None:
         self.cluster = cluster
         #: Mutable: tests rotate the accepted set mid-run to force 401s
         #: (exec-plugin refresh path).  None = no auth required.
         self.accepted_tokens = accepted_tokens
+        #: Priority-and-fairness counters (shared with handler threads):
+        #: ``rejected`` counts load-shed 429s — the tests' observable.
+        self.apf_state = {
+            "lock": threading.Lock(),
+            "active": 0,
+            "rejected": 0,
+        }
         self._handler_cls = type(
             "BoundHandler",
             (_Handler,),
@@ -491,6 +543,10 @@ class ApiServerFacade:
                 # (how the contract tests force the pager onto every
                 # code path).
                 "max_list_page": max_list_page,
+                # >0: APF max-in-flight load shedding (429 + Retry-After
+                # + flow-schema header on concurrent non-watch overflow).
+                "apf_max_inflight": max_inflight,
+                "apf_state": self.apf_state,
             },
         )
         self._server = ThreadingHTTPServer(("127.0.0.1", port), self._handler_cls)

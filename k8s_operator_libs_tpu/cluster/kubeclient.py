@@ -345,6 +345,8 @@ class KubeApiClient:
         self._limiter: Optional[_TokenBucket] = (
             _TokenBucket(config.qps, config.burst) if config.qps > 0 else None
         )
+        #: APF load-shed 429s transparently replayed after Retry-After.
+        self.overload_retries = 0
         parsed = urlparse(config.server)
         self._scheme = parsed.scheme or "http"
         self._host = parsed.hostname or "localhost"
@@ -565,6 +567,26 @@ class KubeApiClient:
             path = f"{path}?{urlencode(query)}"
         payload = json.dumps(body).encode() if body is not None else None
         resp, data = self._transport(method, path, payload, content_type)
+        # Priority-and-fairness load shedding: a 429 carrying the APF
+        # flow-schema header was rejected BEFORE processing, so any verb
+        # is safe to replay after Retry-After (client-go's rest client
+        # honors Retry-After the same way).  Eviction's PDB-driven 429s
+        # carry no such header and surface to the kubectl-style caller
+        # loop unchanged.
+        attempts = 0
+        while (
+            resp.status == 429
+            and resp.getheader("X-Kubernetes-PF-FlowSchema-UID") is not None
+            and attempts < 4
+        ):
+            attempts += 1
+            self.overload_retries += 1
+            try:
+                delay = float(resp.getheader("Retry-After") or 1.0)
+            except ValueError:
+                delay = 1.0
+            time.sleep(min(max(delay, 0.05), 5.0))
+            resp, data = self._transport(method, path, payload, content_type)
         if resp.status == 401 and self.config.exec_plugin is not None:
             # Server-side revocation can precede the credential's stamped
             # expiry: force one plugin re-run and replay.  Any verb is
