@@ -215,9 +215,13 @@ class Block(nn.Module):
                     causal=True,
                 )
 
-        elif cfg.flash_attention and not getattr(
-            _seq_sharding_flag, "on", False
+        elif cfg.flash_attention and (
+            cfg.seq_axis is None
+            or not getattr(_seq_sharding_flag, "on", False)
         ):
+            # the gate mirrors _seq_constrain: the sequence is full per
+            # device unless a seq axis is configured AND a sharded step
+            # is being traced — dp/tp-only meshes keep the flash kernel
             # Per-chip Pallas flash kernel (unsharded path; causal mask
             # + indivisible-seq padding handled inside the kernel seam).
             from .flash_attention import make_flash_attention_fn
