@@ -121,6 +121,18 @@ def _flash_kernel(
         lse_ref[0] = m_ref[..., 0] + jnp.log(l_ref[..., 0])
 
 
+def _group_size(q, k) -> int:
+    """GQA group size g = q_heads // kv_heads (1 = plain MHA; kv_heads
+    == 1 = MQA).  Head dims and batch must already agree."""
+    h, hk = q.shape[2], k.shape[2]
+    if hk == 0 or h % hk:
+        raise ValueError(
+            f"flash_attention GQA needs q heads ({h}) to be a multiple "
+            f"of kv heads ({hk})"
+        )
+    return h // hk
+
+
 def _check_blocks(s: int, block_q: int, block_k: int) -> tuple:
     block_q = min(block_q, s)
     block_k = min(block_k, s)
@@ -136,14 +148,21 @@ def _check_blocks(s: int, block_q: int, block_k: int) -> tuple:
 def _flash_forward(
     q, k, v, causal: bool, block_q: int, block_k: int, interpret: bool
 ):
-    """Returns (out [b,s,h,d], lse [b*h, s] fp32)."""
+    """Returns (out [b,s,h,d], lse [b*h, s] fp32).  Supports GQA/MQA:
+    k/v may carry fewer heads than q (q heads must be a multiple); each
+    group of ``g = h // h_kv`` query heads reads the same K/V tiles via
+    the block index map — no materialized head repetition."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
+    g = _group_size(q, k)
+    hk = h // g
     scale = 1.0 / (d ** 0.5)
     # fold batch x heads into one grid axis; layout [BH, S, D]
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)  # noqa: E731
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(  # noqa: E731
+        b * x.shape[2], s, d
+    )
     qf, kf, vf = fold(q), fold(k), fold(v)
     block_q, block_k = _check_blocks(s, block_q, block_k)
     kernel = functools.partial(
@@ -153,6 +172,8 @@ def _flash_forward(
         causal=causal,
         scale=scale,
     )
+    # bh = bi*h + hj over query heads; the matching kv row is
+    # bi*hk + hj//g == bh // g (exact since h = hk*g)
     out, lse = pl.pallas_call(
         kernel,
         # k innermost: sequential on TPU, so the VMEM scratch carries
@@ -166,12 +187,12 @@ def _flash_forward(
             ),
             pl.BlockSpec(
                 (1, block_k, d),
-                lambda bh, qi, kj: (bh, kj, 0),
+                lambda bh, qi, kj, g=g: (bh // g, kj, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
                 (1, block_k, d),
-                lambda bh, qi, kj: (bh, kj, 0),
+                lambda bh, qi, kj, g=g: (bh // g, kj, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
@@ -313,17 +334,24 @@ def _flash_bwd_dkv_kernel(
 
 
 def _flash_backward(
-    q, k, v, o, lse, g, causal: bool, block_q: int, block_k: int,
+    q, k, v, o, lse, dout, causal: bool, block_q: int, block_k: int,
     interpret: bool,
 ):
-    """Fused flash backward: (dq, dk, dv) with O(seq) memory."""
+    """Fused flash backward: (dq, dk, dv) with O(seq) memory.  GQA: the
+    kernels run over QUERY heads (K/V tiles shared via the index map,
+    like the forward) producing per-query-head dK/dV partials, which a
+    cheap XLA reshape-sum reduces over each group."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
+    g = _group_size(q, k)
+    hk = h // g
     scale = 1.0 / (d ** 0.5)
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)  # noqa: E731
-    qf, kf, vf, dof = fold(q), fold(k), fold(v), fold(g)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(  # noqa: E731
+        b * x.shape[2], s, d
+    )
+    qf, kf, vf, dof = fold(q), fold(k), fold(v), fold(dout)
     block_q, block_k = _check_blocks(s, block_q, block_k)
     # D_i = sum_j P_ij dP_ij = rowsum(dO ∘ O): a cheap XLA elementwise
     # reduction — no reason to burn kernel VMEM on it
@@ -344,12 +372,12 @@ def _flash_backward(
             ),
             pl.BlockSpec(
                 (1, block_k, d),
-                lambda bh, qi, kj: (bh, kj, 0),
+                lambda bh, qi, kj, g=g: (bh // g, kj, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
                 (1, block_k, d),
-                lambda bh, qi, kj: (bh, kj, 0),
+                lambda bh, qi, kj, g=g: (bh // g, kj, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
@@ -390,12 +418,12 @@ def _flash_backward(
             ),
             pl.BlockSpec(
                 (1, block_k, d),
-                lambda bh, kj, qi: (bh, kj, 0),
+                lambda bh, kj, qi, g=g: (bh // g, kj, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
                 (1, block_k, d),
-                lambda bh, kj, qi: (bh, kj, 0),
+                lambda bh, kj, qi, g=g: (bh // g, kj, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
@@ -438,6 +466,16 @@ def _flash_backward(
     )(qf, kf, vf, dof, lse, dvec)
 
     unfold = lambda x: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)  # noqa: E731
+    if g > 1:
+        # per-query-head dK/dV partials -> group sums (the gradient of
+        # the implicit head broadcast)
+        group_sum = lambda x: x.reshape(b, hk, g, s, d).sum(2)  # noqa: E731
+        dk = group_sum(dk).reshape(b * hk, s, d)
+        dv = group_sum(dv).reshape(b * hk, s, d)
+        unfold_kv = lambda x: x.reshape(b, hk, s, d).transpose(  # noqa: E731
+            0, 2, 1, 3
+        )
+        return unfold(dq), unfold_kv(dk), unfold_kv(dv)
     return unfold(dq), unfold(dk), unfold(dv)
 
 
@@ -470,6 +508,12 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, backward):
 def _flash_bwd(causal, block_q, block_k, interpret, backward, residuals, g):
     q, k, v, o, lse = residuals
     if backward == "recompute":
+        if _group_size(q, k) > 1:
+            raise ValueError(
+                "backward='recompute' does not support GQA (the dense "
+                "reference wants equal head counts); use the default "
+                "fused backward"
+            )
         # dense recompute: numerically the same attention,
         # XLA-differentiated — materializes [seq, seq]
         _, vjp = jax.vjp(

@@ -640,6 +640,48 @@ class TestFlashAttention:
                     err = float(jnp.abs(a - b_).max())
                     assert err < 1e-4, (causal, bq, bk, err)
 
+    def test_gqa_and_mqa_match_repeated_head_dense(self):
+        """GQA/MQA: k/v carry fewer heads than q — each group of
+        g = h//h_kv query heads reads the same K/V tiles via the block
+        index map (no materialized repetition), and the fused backward
+        group-sums the dK/dV partials (the gradient of the implicit
+        broadcast).  Reference: dense attention on explicitly repeated
+        heads."""
+        jax, jnp, np, *_ = TestRingAttention._jax()
+        from k8s_operator_libs_tpu.tpu.flash_attention import flash_attention
+        from k8s_operator_libs_tpu.tpu.ring_attention import dense_reference
+
+        rng = np.random.default_rng(7)
+        b, s, h, d = 2, 128, 8, 16
+        for hk in (2, 1):  # GQA and MQA
+            g = h // hk
+            q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+            k = jnp.asarray(rng.standard_normal((b, s, hk, d)), jnp.float32)
+            v = jnp.asarray(rng.standard_normal((b, s, hk, d)), jnp.float32)
+            rep = lambda x: jnp.repeat(x, g, axis=2)  # noqa: E731
+            out = flash_attention(q, k, v, True, 64, 64, True)
+            ref = dense_reference(q, rep(k), rep(v), True)
+            assert float(jnp.abs(out - ref).max()) < 1e-5
+            gf = jax.grad(
+                lambda a, b_, c: (
+                    flash_attention(a, b_, c, True, 64, 64, True) ** 2
+                ).sum(),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            gr = jax.grad(
+                lambda a, b_, c: (
+                    dense_reference(a, rep(b_), rep(c), True) ** 2
+                ).sum(),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            for a, b_ in zip(gf, gr):
+                assert float(jnp.abs(a - b_).max()) < 1e-3, hk
+        import pytest as _pytest
+
+        k3 = jnp.asarray(rng.standard_normal((b, s, 3, d)), jnp.float32)
+        with _pytest.raises(ValueError):
+            flash_attention(q, k3, k3, True, 64, 64, True)
+
     def test_gradients_recompute_backward_fallback(self):
         """backward="recompute" (the debugging fallback) differentiates
         dense attention and must agree with the fused default."""
