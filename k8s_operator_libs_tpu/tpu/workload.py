@@ -72,6 +72,13 @@ class ModelConfig:
     #: (no mesh) — ring_attention covers the cross-chip case.  Backward
     #: is a dense recompute (see the module docstring).
     flash_attention: bool = False
+    #: Autoregressive decoding mode: attention runs with flax's KV
+    #: cache (``nn.MultiHeadDotProductAttention(decode=True)``), one
+    #: token per call — the serving hot path.  The param tree is
+    #: IDENTICAL to training mode (the cache lives in the separate
+    #: "cache" collection), so trained weights drop straight into a
+    #: decode-mode model — see :func:`greedy_generate`.
+    decode: bool = False
 
 
 import logging as _logging
@@ -185,7 +192,12 @@ class Block(nn.Module):
         # equivalence tests) however the scores are computed.
         attention_fn = None
         mask = None
-        if use_ring:
+        if cfg.decode:
+            # KV-cache decoding: flax masks against the cache index
+            # internally; a mask/attention_fn here would be wrong for
+            # the one-token query (and sharded modes don't apply)
+            pass
+        elif use_ring:
             # Ring attention: the sequence STAYS sharded — the qkv
             # projections are feature-dim ops (fine on seq shards) and
             # the attention itself rotates K/V blocks over the ring
@@ -250,6 +262,7 @@ class Block(nn.Module):
             dtype=cfg.dtype,
             qkv_features=cfg.d_model,
             deterministic=True,
+            decode=cfg.decode,
             name="attn",
             **attn_kwargs,
         )(h, mask=mask)
@@ -272,14 +285,16 @@ class TinyLM(nn.Module):
     config: ModelConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, positions=None):
         cfg = self.config
         x = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed"
         )(tokens)
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])[None, :]
         pos = nn.Embed(
             cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, name="pos_embed"
-        )(jnp.arange(tokens.shape[1])[None, :])
+        )(positions)
         x = x + pos
         x = _seq_constrain(x, cfg, seq_sharded=True)
         for i in range(cfg.n_layers):
@@ -414,6 +429,80 @@ def make_train_step(model: TinyLM, tx, mesh: Optional[Mesh] = None):
         return params, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1))
+
+
+def greedy_generate(
+    config: ModelConfig,
+    params,
+    prompt,
+    max_new_tokens: int,
+):
+    """KV-cache greedy decoding — the serving path.
+
+    Runs TinyLM one token at a time in flax decode mode: each step's
+    K/V lands in the per-layer cache (write at the cache index, no
+    recompute of the prefix), so a T-token generation is O(T·seq)
+    attention work instead of the O(T·seq²) of full-prefix recompute.
+    Trained weights drop in unchanged (the cache is a separate flax
+    collection; the param tree is identical to training mode).
+
+    *prompt* is [batch, prompt_len] int32 (one shared prompt length);
+    returns [batch, prompt_len + max_new_tokens] — prompt tokens are
+    teacher-forced, the rest greedy-argmax.  The whole loop is one
+    ``lax.scan`` under jit: static shapes, no host round trips per
+    token.  Decode mode is the unsharded per-chip path (serving
+    replicates by batch); MoE configs are supported, sharded/ring modes
+    are not (decode forces them off)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        config,
+        decode=True,
+        seq_axis=None,
+        ring_attention=False,
+        flash_attention=False,
+    )
+    b, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds max_seq_len ({cfg.max_seq_len})"
+        )
+    model = TinyLM(cfg)
+    # init-time input length sizes the per-layer cache buffers
+    cache = model.init(
+        jax.random.key(0), jnp.zeros((b, cfg.max_seq_len), jnp.int32)
+    )["cache"]
+
+    buf = jnp.zeros((b, total), jnp.int32).at[:, :prompt_len].set(prompt)
+
+    def step(carry, i):
+        cache, buf = carry
+        token = jax.lax.dynamic_slice_in_dim(buf, i, 1, axis=1)
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            token,
+            positions=jnp.full((b, 1), i, jnp.int32),
+            mutable=["cache"],
+        )
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        # teacher-force inside the prompt; greedy beyond it
+        inside = i + 1 < prompt_len
+        current = jax.lax.dynamic_slice_in_dim(buf, i + 1, 1, axis=1)[:, 0]
+        written = jnp.where(inside, current, nxt.astype(jnp.int32))
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, written[:, None], i + 1, axis=1
+        )
+        return (mutated["cache"], buf), None
+
+    def run(cache, buf):
+        (cache, buf), _ = jax.lax.scan(
+            step, (cache, buf), jnp.arange(total - 1)
+        )
+        return buf
+
+    return jax.jit(run)(cache, buf)
 
 
 def make_batch(config: ModelConfig, batch_size: int, seed: int = 0):

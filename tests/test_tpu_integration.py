@@ -754,3 +754,66 @@ class TestFlashAttention:
         _, _, lg = step_g(copy(params), copy(opt), batch)
         _, _, lf = step_f(copy(params), copy(opt), batch)
         assert abs(float(lg) - float(lf)) < 1e-4
+
+
+class TestGreedyDecode:
+    """KV-cache serving path (workload.greedy_generate): one-token
+    decode steps against flax's per-layer cache must reproduce exactly
+    the tokens of full-prefix recompute through the training-mode
+    model — same params, zero drift."""
+
+    def _check(self, cfg):
+        jax, jnp, np, *_ = TestRingAttention._jax()
+        from k8s_operator_libs_tpu.tpu import workload as wl
+
+        model, params, _tx, _opt = wl.create_train_state(cfg)
+        prompt = jnp.asarray(
+            np.random.default_rng(3).integers(0, cfg.vocab_size, (2, 4)),
+            jnp.int32,
+        )
+        out = wl.greedy_generate(cfg, params, prompt, max_new_tokens=6)
+        assert out.shape == (2, 10)
+        assert (np.asarray(out[:, :4]) == np.asarray(prompt)).all()
+        buf = np.array(out[:, :4])
+        full = wl.TinyLM(cfg)
+        for _ in range(6):
+            logits = full.apply({"params": params}, jnp.asarray(buf))
+            nxt = np.argmax(np.asarray(logits[:, -1], np.float32), -1)
+            buf = np.concatenate([buf, nxt[:, None]], axis=1)
+        assert (np.asarray(out) == buf).all()
+
+    def test_dense_decode_matches_recompute(self):
+        from k8s_operator_libs_tpu.tpu.workload import ModelConfig
+
+        self._check(
+            ModelConfig(
+                vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                d_ff=64, max_seq_len=16,
+            )
+        )
+
+    def test_moe_decode_matches_recompute(self):
+        """Soft-MoE routes per token, so the expert path decodes too."""
+        from k8s_operator_libs_tpu.tpu.workload import ModelConfig
+
+        self._check(
+            ModelConfig(
+                vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                d_ff=64, max_seq_len=16, n_experts=4,
+            )
+        )
+
+    def test_budget_overflow_rejected(self):
+        import pytest as _pytest
+
+        jax, jnp, np, *_ = TestRingAttention._jax()
+        from k8s_operator_libs_tpu.tpu import workload as wl
+
+        cfg = wl.ModelConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=1,
+            d_ff=64, max_seq_len=8,
+        )
+        _model, params, _tx, _opt = wl.create_train_state(cfg)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with _pytest.raises(ValueError):
+            wl.greedy_generate(cfg, params, prompt, max_new_tokens=8)
