@@ -195,6 +195,8 @@ class InformerCache:
         (NodeUpgradeStateProvider._cache_caught_up) polls this once per
         write per poll interval; full copies per poll are pure overhead
         at fleet scale.  None when the object is not (yet) visible."""
+        from .inmem import rv_str
+
         self._check_kind(kind)
         if self.lag_seconds <= 0:
             peek = getattr(self._cluster, "resource_version_of", None)
@@ -204,15 +206,11 @@ class InformerCache:
                 obj = self._cluster.get(kind, name, namespace)
             except NotFoundError:
                 return None
-            rv = (obj.get("metadata") or {}).get("resourceVersion")
-            return rv if isinstance(rv, str) else None
+            return rv_str(obj)
         self._maybe_refresh()
         with self._lock:
             obj = self._snapshot.get((kind, namespace, name))
-            if obj is None:
-                return None
-            rv = (obj.get("metadata") or {}).get("resourceVersion")
-            return rv if isinstance(rv, str) else None
+            return None if obj is None else rv_str(obj)
 
     def list(
         self, kind: str, namespace: Optional[str] = None, label_selector: str = ""

@@ -72,6 +72,14 @@ def json_copy(obj: Any) -> Any:
     return copy.deepcopy(obj)
 
 
+def rv_str(obj: JsonObj) -> Optional[str]:
+    """The object's ``metadata.resourceVersion`` when it is a string
+    (the only representation this store writes), else None — shared by
+    every copy-free rv probe and the blob-cache validity check."""
+    rv = (obj.get("metadata") or {}).get("resourceVersion")
+    return rv if isinstance(rv, str) else None
+
+
 def _key_of(obj: JsonObj) -> Key:
     kind = obj.get("kind")
     meta = obj.get("metadata") or {}
@@ -220,8 +228,8 @@ class InMemoryCluster:
         """Deep-copy *obj* for hand-out, via the rv-validated blob cache
         (see ``_blobs``).  Unmarshalable trees (tests sometimes stash
         helper objects on metadata) fall back to :func:`json_copy`."""
-        rv = (obj.get("metadata") or {}).get("resourceVersion")
-        if not isinstance(rv, str):
+        rv = rv_str(obj)
+        if rv is None:
             return json_copy(obj)
         hit = self._blobs.get(key)
         if hit is not None and hit[0] == rv:
@@ -361,10 +369,7 @@ class InMemoryCluster:
         scale.  None when the object does not exist."""
         with self._lock:
             obj = self._store.get((kind, namespace, name))
-            if obj is None:
-                return None
-            rv = (obj.get("metadata") or {}).get("resourceVersion")
-            return rv if isinstance(rv, str) else None
+            return None if obj is None else rv_str(obj)
 
     def list(
         self,

@@ -1509,6 +1509,11 @@ class _HeldWatcher(threading.Thread):
                 except json.JSONDecodeError:
                     pass
                 raise client._to_api_error(resp.status, parsed)
+            # the watch is established: client-go resets reflector
+            # backoff HERE, not only on natural expiry — a flaky LB
+            # RSTing healthy streams must not ratchet every reconnect
+            # to the 30s cap
+            self._backoff.reset()
             while not self._stop_event.is_set():
                 line = resp.readline()
                 if not line:

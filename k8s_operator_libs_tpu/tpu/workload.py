@@ -68,9 +68,10 @@ class ModelConfig:
     #: kernel streams K/V blocks through VMEM with the online-softmax
     #: accumulator and prunes the causal k-loop — never materializing
     #: the [seq, seq] score matrix; measured faster than XLA dense
-    #: attention on TPU v5e from seq ~1k.  Used on the UNSHARDED path
-    #: (no mesh) — ring_attention covers the cross-chip case.  Backward
-    #: is a dense recompute (see the module docstring).
+    #: attention on TPU v5e from seq ~1k.  Used when the sequence is
+    #: full per device (dp/tp meshes included) — ring_attention covers
+    #: the seq-sharded cross-chip case.  Backward is the fused Pallas
+    #: kernel pair (O(seq) training memory; see the module docstring).
     flash_attention: bool = False
     #: Autoregressive decoding mode: attention runs with flax's KV
     #: cache (``nn.MultiHeadDotProductAttention(decode=True)``), one
@@ -470,9 +471,12 @@ def greedy_generate(
             f"exceeds max_seq_len ({cfg.max_seq_len})"
         )
     model = TinyLM(cfg)
-    # init-time input length sizes the per-layer cache buffers
+    # init-time input length sizes the per-layer cache buffers: size to
+    # THIS generation's span, not max_seq_len — flax's decode attention
+    # scores against every cached position each step, so an oversized
+    # cache multiplies both memory and per-step FLOPs
     cache = model.init(
-        jax.random.key(0), jnp.zeros((b, cfg.max_seq_len), jnp.int32)
+        jax.random.key(0), jnp.zeros((b, total), jnp.int32)
     )["cache"]
 
     buf = jnp.zeros((b, total), jnp.int32).at[:, :prompt_len].set(prompt)
