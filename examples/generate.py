@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""KV-cache serving demo: train the demo LM a few steps (or restore an
+orbax checkpoint saved by the trainer / checkpoint-on-drain handshake),
+then greedy-decode continuations with the per-layer KV cache.
+
+The serving half of the TPU workload story: the same weights move from
+the training path (`make_train_step`, checkpointed on drain) into
+decode mode unchanged — the cache is a separate flax collection, so the
+param tree is identical (reference has no compute; this exceeds it —
+see PARITY.md "Long-context / distributed compute").
+
+    python examples/generate.py --steps 20 --new-tokens 16
+    python examples/generate.py --restore-dir /ckpts --restore-step 100
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument(
+        "--steps", type=int, default=10,
+        help="quick training steps before decoding (ignored with "
+        "--restore-dir)",
+    )
+    parser.add_argument(
+        "--restore-dir", default=None,
+        help="orbax checkpoint directory to restore instead of training",
+    )
+    parser.add_argument("--restore-step", type=int, default=None)
+    parser.add_argument("--batch", type=int, default=2)
+    parser.add_argument("--prompt-len", type=int, default=8)
+    parser.add_argument("--new-tokens", type=int, default=16)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k8s_operator_libs_tpu.tpu.workload import (
+        ModelConfig,
+        create_train_state,
+        greedy_generate,
+        make_batch,
+        make_train_step,
+        restore_checkpoint,
+    )
+
+    config = ModelConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=64,
+    )
+    model, params, tx, opt_state = create_train_state(config)
+
+    if args.restore_dir:
+        restored = restore_checkpoint(
+            args.restore_dir,
+            args.restore_step,
+            like={
+                "step": 0,
+                "params": jax.device_get(params),
+                "opt_state": jax.device_get(opt_state),
+            },
+        )
+        params = jax.device_put(restored["params"])
+        print(f"restored checkpoint step {restored['step']}")
+    else:
+        step = make_train_step(model, tx)
+        for i in range(args.steps):
+            batch = make_batch(config, 8, seed=i)
+            params, opt_state, loss = step(params, opt_state, batch)
+        print(f"trained {args.steps} steps, loss {float(loss):.4f}")
+
+    rng = np.random.default_rng(args.seed)
+    prompt = jnp.asarray(
+        rng.integers(0, config.vocab_size, (args.batch, args.prompt_len)),
+        jnp.int32,
+    )
+    out = greedy_generate(config, params, prompt, args.new_tokens)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = greedy_generate(config, params, prompt, args.new_tokens)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    for row in np.asarray(out):
+        head = " ".join(str(t) for t in row[: args.prompt_len])
+        tail = " ".join(str(t) for t in row[args.prompt_len:])
+        print(f"prompt [{head}] -> [{tail}]")
+    rate = args.batch * args.new_tokens / max(elapsed, 1e-9)
+    print(
+        f"{args.new_tokens} tokens x {args.batch} sequences in "
+        f"{elapsed*1e3:.1f} ms ({rate:.0f} tokens/s, KV-cache decode)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,39 @@ def _attention_bench(iters: int = 30) -> Dict[str, Any]:
     return out
 
 
+def _decode_bench(config, params) -> Dict[str, Any]:
+    """KV-cache greedy decoding throughput on the chip — the serving
+    number (tokens/s at batch 8), measured with the just-trained
+    weights."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .workload import greedy_generate
+
+    b = 8
+    new_tokens = config.max_seq_len - 16
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, config.vocab_size, (b, 16)), jnp.int32
+    )
+    jax.block_until_ready(
+        greedy_generate(config, params, prompt, new_tokens)
+    )  # compile
+    t0 = _time.perf_counter()
+    out = greedy_generate(config, params, prompt, new_tokens)
+    jax.block_until_ready(out)
+    elapsed = _time.perf_counter() - t0
+    return {
+        "batch": b,
+        "new_tokens": new_tokens,
+        "tokens_per_s": round(b * new_tokens / elapsed, 1),
+        "ms_per_token": round(elapsed / new_tokens * 1e3, 3),
+    }
+
+
 def run_smoke(
     checkpoint_dir: str,
     steps: int = 10,
@@ -206,6 +239,10 @@ def run_smoke(
             result["attention_kernel"] = _attention_bench()
         except Exception as err:  # noqa: BLE001 — per-section degrade
             result["attention_kernel"] = {"error": str(err)[:300]}
+        try:
+            result["decode"] = _decode_bench(config, trainer.params)
+        except Exception as err:  # noqa: BLE001 — per-section degrade
+            result["decode"] = {"error": str(err)[:300]}
 
     if not drain:
         return result

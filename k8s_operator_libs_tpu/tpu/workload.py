@@ -432,6 +432,11 @@ def make_train_step(model: TinyLM, tx, mesh: Optional[Mesh] = None):
     return jax.jit(step, donate_argnums=(0, 1))
 
 
+#: jitted decode loops keyed by (config, batch, prompt_len, total) —
+#: see greedy_generate
+_decode_loop_cache: dict = {}
+
+
 def greedy_generate(
     config: ModelConfig,
     params,
@@ -481,32 +486,51 @@ def greedy_generate(
 
     buf = jnp.zeros((b, total), jnp.int32).at[:, :prompt_len].set(prompt)
 
-    def step(carry, i):
-        cache, buf = carry
-        token = jax.lax.dynamic_slice_in_dim(buf, i, 1, axis=1)
-        logits, mutated = model.apply(
-            {"params": params, "cache": cache},
-            token,
-            positions=jnp.full((b, 1), i, jnp.int32),
-            mutable=["cache"],
-        )
-        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
-        # teacher-force inside the prompt; greedy beyond it
-        inside = i + 1 < prompt_len
-        current = jax.lax.dynamic_slice_in_dim(buf, i + 1, 1, axis=1)[:, 0]
-        written = jnp.where(inside, current, nxt.astype(jnp.int32))
-        buf = jax.lax.dynamic_update_slice_in_dim(
-            buf, written[:, None], i + 1, axis=1
-        )
-        return (mutated["cache"], buf), None
+    # one jitted loop per (shape, config) signature: a fresh closure
+    # per call would defeat jax's jit cache and re-trace every
+    # generation — fatal for a serving path
+    memo_key = (
+        cfg.vocab_size, cfg.d_model, cfg.n_heads, cfg.n_layers, cfg.d_ff,
+        cfg.max_seq_len, cfg.n_experts, str(cfg.dtype), b, prompt_len,
+        total,
+    )
+    run = _decode_loop_cache.get(memo_key)
+    if run is None:
 
-    def run(cache, buf):
-        (cache, buf), _ = jax.lax.scan(
-            step, (cache, buf), jnp.arange(total - 1)
-        )
-        return buf
+        def run_impl(p, cache, buf):
+            def step(carry, i):
+                cache_c, buf_c = carry
+                token = jax.lax.dynamic_slice_in_dim(buf_c, i, 1, axis=1)
+                logits, mutated = model.apply(
+                    {"params": p, "cache": cache_c},
+                    token,
+                    positions=jnp.full((b, 1), i, jnp.int32),
+                    mutable=["cache"],
+                )
+                nxt = jnp.argmax(
+                    logits[:, -1].astype(jnp.float32), axis=-1
+                )
+                # teacher-force inside the prompt; greedy beyond it
+                inside = i + 1 < prompt_len
+                current = jax.lax.dynamic_slice_in_dim(
+                    buf_c, i + 1, 1, axis=1
+                )[:, 0]
+                written = jnp.where(inside, current, nxt.astype(jnp.int32))
+                buf_c = jax.lax.dynamic_update_slice_in_dim(
+                    buf_c, written[:, None], i + 1, axis=1
+                )
+                return (mutated["cache"], buf_c), None
 
-    return jax.jit(run)(cache, buf)
+            (cache, buf), _ = jax.lax.scan(
+                step, (cache, buf), jnp.arange(total - 1)
+            )
+            return buf
+
+        run = jax.jit(run_impl)
+        if len(_decode_loop_cache) >= 64:
+            _decode_loop_cache.clear()
+        _decode_loop_cache[memo_key] = run
+    return run(params, cache, buf)
 
 
 def make_batch(config: ModelConfig, batch_size: int, seed: int = 0):
