@@ -156,6 +156,9 @@ def run_rollout_http(policy: UpgradePolicySpec, max_cycles: int = 2000) -> float
             cascade=True,
             cache_sync_timeout_seconds=5.0,
             cache_sync_poll_seconds=0.005,
+            # controller-runtime parity: snapshot reads ride the
+            # held-stream-fed informer cache, not per-cycle HTTP LISTs
+            reads_from_cache=True,
         )
         t0 = time.monotonic()
         for _ in range(max_cycles):

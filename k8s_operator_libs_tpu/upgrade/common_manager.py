@@ -116,8 +116,13 @@ class CommonUpgradeManager:
         recorder: Optional[EventRecorder] = None,
         pod_deletion_enabled: bool = False,
         validation_enabled: bool = False,
+        reader=None,
     ) -> None:
         self._cluster = cluster
+        #: Snapshot reads (DaemonSet listing) — an informer cache when
+        #: the state manager runs cache-backed (controller-runtime
+        #: parity), else the cluster itself.
+        self._reader = reader if reader is not None else cluster
         self.provider = provider
         self.cordon_manager = cordon_manager
         self.drain_manager = drain_manager
@@ -577,7 +582,7 @@ class CommonUpgradeManager:
         from ..cluster.selectors import labels_to_selector
 
         out: Dict[str, JsonObj] = {}
-        for ds in self._cluster.list(
+        for ds in self._reader.list(
             "DaemonSet", namespace=namespace,
             label_selector=labels_to_selector(labels),
         ):

@@ -70,10 +70,17 @@ class PodManager:
         recorder: Optional[EventRecorder] = None,
         pod_deletion_filter: Optional[PodDeletionFilter] = None,
         pool: Optional[ThreadPoolExecutor] = None,
+        revision_reader=None,
     ) -> None:
         from .drain_manager import DEFAULT_WORKER_POOL_SIZE
 
         self._cluster = cluster
+        #: ControllerRevision reads for the revision-hash oracle — an
+        #: informer cache when the state manager runs cache-backed
+        #: (controller-runtime parity), else the cluster itself.
+        self._revision_reader = (
+            revision_reader if revision_reader is not None else cluster
+        )
         self._provider = provider
         self._recorder = recorder
         self._filter = pod_deletion_filter
@@ -161,7 +168,7 @@ class PodManager:
         # filtering with the DS's label selector first (pod_manager.go:95).
         revisions = [
             cr
-            for cr in self._cluster.list(
+            for cr in self._revision_reader.list(
                 "ControllerRevision", namespace=namespace_of(daemonset)
             )
             if is_owned_by(cr, daemonset)

@@ -78,9 +78,19 @@ class ClusterUpgradeStateManager:
         pod_manager: Optional[PodManager] = None,
         validation_manager: Optional[ValidationManager] = None,
         safe_driver_load_manager: Optional[SafeDriverLoadManager] = None,
+        reads_from_cache: bool = False,
     ) -> None:
         self._cluster = cluster
         self._cache = cache or InformerCache(cluster, lag_seconds=0.0)
+        #: controller-runtime parity: the manager's SNAPSHOT reads (the
+        #: BuildState Pod/DaemonSet lists and the DS-revision oracle)
+        #: can ride the informer cache instead of hitting the apiserver
+        #: every cycle — with held watch streams that turns per-cycle
+        #: LISTs into local snapshot reads.  Opt-in: writes and the
+        #: visibility waits keep their semantics either way, but
+        #: cache-lagged snapshots are the reference's real behavior.
+        self._reader = self._cache if reads_from_cache else cluster
+        self._reads_from_cache = reads_from_cache
         self._recorder = recorder
         #: Synchronous state transitions performed by the most recent
         #: apply_state pass (see that method's docstring).
@@ -118,7 +128,11 @@ class ClusterUpgradeStateManager:
         if drain_manager is None:
             self._owned_managers.append(self._drain_manager)
         self._pod_manager = pod_manager or PodManager(
-            cluster, self._provider, recorder, pool=shared_pool
+            cluster,
+            self._provider,
+            recorder,
+            pool=shared_pool,
+            revision_reader=self._reader if reads_from_cache else None,
         )
         if pod_manager is None:
             self._owned_managers.append(self._pod_manager)
@@ -214,6 +228,7 @@ class ClusterUpgradeStateManager:
                 self._recorder,
                 pod_deletion_enabled=self._pod_deletion_enabled,
                 validation_enabled=self._validation_enabled,
+                reader=self._reader if self._reads_from_cache else None,
             )
             self._inplace = InplaceNodeStateManager(self._common)
         return self._common
@@ -262,7 +277,7 @@ class ClusterUpgradeStateManager:
         self.pod_manager.reset_revision_memo()
         state = ClusterUpgradeState()
         daemon_sets = common.get_driver_daemon_sets(namespace, driver_labels)
-        pods = self._cluster.list(
+        pods = self._reader.list(
             "Pod",
             namespace=namespace,
             label_selector=labels_to_selector(driver_labels),

@@ -2854,3 +2854,107 @@ class TestPriorityAndFairness:
         finally:
             facade.stop()
         assert client.overload_retries == 0
+
+
+class TestCacheBackedReads:
+    """reads_from_cache=True (controller-runtime parity): the state
+    manager's snapshot reads — BuildState's Pod/DaemonSet lists and the
+    DS-revision oracle — ride the informer cache instead of issuing
+    apiserver LISTs every reconcile cycle."""
+
+    def test_rollout_converges_with_cache_reads_and_no_per_cycle_lists(self):
+        from k8s_operator_libs_tpu.api import (
+            DrainSpec,
+            IntOrString,
+            UpgradePolicySpec,
+        )
+        from k8s_operator_libs_tpu.cluster import InformerCache
+        from k8s_operator_libs_tpu.upgrade import consts
+        from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+            ClusterUpgradeStateManager,
+        )
+
+        from harness import DRIVER_LABELS, NAMESPACE, Fleet
+
+        store = InMemoryCluster()
+        with ApiServerFacade(store) as facade:
+            client = KubeApiClient(KubeConfig(server=facade.url), timeout=10.0)
+            client.start_held_watches(
+                ("Node", "Pod", "DaemonSet"), hold_seconds=3.0
+            )
+            try:
+                fleet = Fleet(client)
+                for i in range(2):
+                    fleet.add_node(f"n{i}", pod_hash="rev1")
+                fleet.publish_new_revision("rev2")
+                cache = InformerCache(
+                    client,
+                    lag_seconds=0.01,
+                    kinds=(
+                        "Node", "Pod", "DaemonSet", "ControllerRevision"
+                    ),
+                )
+                manager = ClusterUpgradeStateManager(
+                    client,
+                    cache=cache,
+                    cache_sync_timeout_seconds=2.0,
+                    cache_sync_poll_seconds=0.01,
+                    reads_from_cache=True,
+                )
+                # spy: the manager must NOT list Pod/DaemonSet/
+                # ControllerRevision through the HTTP client once the
+                # cache is the reader
+                listed_kinds = []
+                spy_on = [False]
+                orig_list = client.list
+
+                def spy_list(kind, *a, **kw):
+                    if spy_on[0]:
+                        listed_kinds.append(kind)
+                    return orig_list(kind, *a, **kw)
+
+                client.list = spy_list
+                # the cache itself seeds/refreshes via the client —
+                # only count lists made DURING reconcile cycles
+                policy = UpgradePolicySpec(
+                    auto_upgrade=True,
+                    max_parallel_upgrades=0,
+                    max_unavailable=IntOrString("100%"),
+                    drain_spec=DrainSpec(
+                        enable=True, force=True, timeout_second=10
+                    ),
+                )
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    # spy only the manager's reads: the harness fleet
+                    # (the simulated kubelet/DS controller) legitimately
+                    # lists through the same client
+                    spy_on[0] = True
+                    state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+                    manager.apply_state(state, policy)
+                    spy_on[0] = False
+                    manager.drain_manager.wait_idle(10.0)
+                    manager.pod_manager.wait_idle(10.0)
+                    fleet.reconcile_daemonset()
+                    if set(fleet.states().values()) == {
+                        consts.UPGRADE_STATE_DONE
+                    }:
+                        break
+                    time.sleep(0.02)
+                assert set(fleet.states().values()) == {
+                    consts.UPGRADE_STATE_DONE
+                }
+            finally:
+                client.list = orig_list
+                try:
+                    client.stop_held_watches()
+                except Exception:  # noqa: BLE001
+                    pass
+        # the snapshot reads rode the cache: the cache's own refresh
+        # may list (bounded-poll seeding of non-held kinds), but the
+        # per-cycle manager reads must not have hit the client at all
+        # for held kinds — the cache serves them from the snapshot.
+        assert "Pod" not in listed_kinds or listed_kinds.count("Pod") <= 2, (
+            listed_kinds
+        )
+        assert listed_kinds.count("DaemonSet") <= 2, listed_kinds
