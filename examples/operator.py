@@ -62,7 +62,23 @@ def run_real(args) -> int:
     config.burst = args.burst
     client = KubeApiClient(config)
     recorder = util.ClusterEventRecorder(client, namespace=args.namespace)
-    manager = ClusterUpgradeStateManager(client, recorder=recorder)
+    # controller-runtime reading model: snapshot reads ride an informer
+    # cache fed by the held watch streams (started by the runnable
+    # below) instead of LISTing the apiserver every reconcile
+    from k8s_operator_libs_tpu.cluster import InformerCache
+
+    # externally_fed: the watch stream is single-consumer, so the
+    # CONTROLLER drains it and tees every batch into this cache
+    # (feed_cache below) — one reflector feeding store + workqueue
+    cache = InformerCache(
+        client,
+        lag_seconds=0.05,
+        kinds=("Node", "Pod", "DaemonSet", "ControllerRevision"),
+        externally_fed=True,
+    )
+    manager = ClusterUpgradeStateManager(
+        client, cache=cache, recorder=recorder, reads_from_cache=True
+    )
     labels = {}
     for pair in args.selector.split(","):
         if not pair:
@@ -87,6 +103,7 @@ def run_real(args) -> int:
             labels,
             policy_source=CrPolicySource(client, args.policy, args.namespace),
             resync_seconds=args.resync_seconds,
+            feed_cache=cache,
         )
         return _HeldWatchRunnable(
             client, ("Node", "Pod", "DaemonSet", "TpuUpgradePolicy"), controller

@@ -47,6 +47,7 @@ class InformerCache:
         cluster: ClusterClient,
         lag_seconds: float = 0.0,
         kinds: Optional[tuple] = None,
+        externally_fed: bool = False,
     ) -> None:
         """*kinds*: restrict the cached/watched kinds (None = every
         registered kind).  On HTTP backends an unfiltered refresh issues
@@ -55,11 +56,18 @@ class InformerCache:
         upgrade manager reads Nodes/Pods/DaemonSets/...) should pass it.
         NOTE (HTTP backends): the watch stream is single-consumer per
         KubeApiClient — a lagged cache sharing a client with a running
-        Controller would steal its events; give the cache its own client.
-        """
+        Controller would steal its events.  Either give the cache its
+        own client, or set *externally_fed* and have the single watch
+        consumer (the Controller, via its ``event_sink`` hook) push
+        frames into :meth:`ingest` — the informer architecture: one
+        reflector feeds both the store and the workqueue."""
         self._cluster = cluster
         self.lag_seconds = lag_seconds
         self._kinds = tuple(sorted(kinds)) if kinds else None
+        #: True = this cache never consumes the journal itself: the
+        #: owner pushes deltas via ingest()/sync() (reads still trigger
+        #: a one-time seeding sync).
+        self.externally_fed = externally_fed
         self._lock = threading.Lock()
         # Refresh serialization — the single-reflector rule.  Reads come
         # from many threads (drain/pod workers polling visibility), but
@@ -81,6 +89,11 @@ class InformerCache:
         # startup snapshot (a full cluster dump over HTTP, per kind).
         if lag_seconds > 0:
             self.sync()
+
+    @property
+    def kinds(self) -> Optional[tuple]:
+        """The cached kind set (None = every registered kind)."""
+        return self._kinds
 
     # ------------------------------------------------------------ refresh
     def sync(self) -> None:
@@ -110,34 +123,50 @@ class InformerCache:
             except ExpiredError:
                 self.sync()
                 return
-            with self._lock:
-                for ev in events:
-                    obj = ev.new if ev.new is not None else ev.old
-                    if obj is None:
-                        continue
-                    meta = obj.get("metadata") or {}
-                    key = (
-                        obj.get("kind", ""),
-                        meta.get("namespace", ""),
-                        meta.get("name", ""),
-                    )
-                    if self._applied_newer(key, ev.seq):
-                        # Monotonic apply guard: a replayed/duplicated
-                        # frame (held-stream reconnect, sync overlap)
-                        # must never regress an object the view already
-                        # holds at a newer revision — including a stale
-                        # Deleted frame popping a live object (on a
-                        # delete-then-recreate, the recreate's Added
-                        # carries the higher RV, so skipping the stale
-                        # Deleted is the correct order-restored result).
-                        continue
-                    if ev.type == "Deleted":
-                        self._snapshot.pop(key, None)
-                    else:
-                        self._snapshot[key] = json_copy(obj)
-                    self._last_seq = max(self._last_seq, ev.seq)
+            self._apply_events(events, head)
+
+    def ingest(self, events) -> None:
+        """Apply watch deltas pushed by an external consumer (the
+        Controller's ``event_sink``) — the externally-fed half of the
+        single-reflector rule; see ``__init__``.  Safe on any cache, but
+        only an ``externally_fed`` one depends on it."""
+        if not events:
+            return
+        with self._refresh_serial:
+            self._apply_events(events, head=None)
+
+    def _apply_events(self, events, head) -> None:
+        """Delta application shared by the self-refresh and ingest
+        paths.  Caller holds ``_refresh_serial``."""
+        with self._lock:
+            for ev in events:
+                obj = ev.new if ev.new is not None else ev.old
+                if obj is None:
+                    continue
+                meta = obj.get("metadata") or {}
+                key = (
+                    obj.get("kind", ""),
+                    meta.get("namespace", ""),
+                    meta.get("name", ""),
+                )
+                if self._applied_newer(key, ev.seq):
+                    # Monotonic apply guard: a replayed/duplicated
+                    # frame (held-stream reconnect, sync overlap)
+                    # must never regress an object the view already
+                    # holds at a newer revision — including a stale
+                    # Deleted frame popping a live object (on a
+                    # delete-then-recreate, the recreate's Added
+                    # carries the higher RV, so skipping the stale
+                    # Deleted is the correct order-restored result).
+                    continue
+                if ev.type == "Deleted":
+                    self._snapshot.pop(key, None)
+                else:
+                    self._snapshot[key] = json_copy(obj)
+                self._last_seq = max(self._last_seq, ev.seq)
+            if head is not None:
                 self._last_seq = max(self._last_seq, head)
-                self._last_sync = time.monotonic()
+            self._last_sync = time.monotonic()
 
     def _applied_newer(self, key: Key, seq: int) -> bool:
         """True when the view already holds *key* at a revision >= *seq*
@@ -153,6 +182,14 @@ class InformerCache:
             return False
 
     def _maybe_refresh(self) -> None:
+        if self.externally_fed:
+            # the external feeder owns journal consumption; reads only
+            # trigger the one-time seeding list
+            with self._lock:
+                seeded = self._last_sync != float("-inf")
+            if not seeded:
+                self.sync()
+            return
         with self._lock:
             stale = time.monotonic() - self._last_sync >= self.lag_seconds
         if stale:

@@ -101,6 +101,8 @@ class Controller:
         resync_seconds: float = 0.0,
         max_retries: Optional[int] = None,
         queue: Optional[RateLimitedQueue] = None,
+        event_sink=None,
+        relist_sink=None,
     ) -> None:
         self._cluster = cluster
         self._reconciler = reconciler
@@ -109,6 +111,15 @@ class Controller:
         self._resync = resync_seconds
         self._max_retries = max_retries
         self._queue = queue or RateLimitedQueue()
+        #: Informer tee (single-reflector rule): on HTTP backends the
+        #: watch stream is pop-once, so an InformerCache sharing this
+        #: client must NOT consume it too.  *event_sink* receives every
+        #: drained event batch BEFORE fan-out (reconciles woken by an
+        #: event then read a cache that already reflects it) —
+        #: typically ``cache.ingest``; *relist_sink* runs on the 410
+        #: recovery path — typically ``cache.sync``.
+        self._event_sink = event_sink
+        self._relist_sink = relist_sink
         self._watches: List[_Watch] = []
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
@@ -249,6 +260,15 @@ class Controller:
                 logger.error("%s: watch poll failed: %s", self.name, err)
                 self._stop.wait(self._poll)
                 continue
+            if self._event_sink is not None and events:
+                try:
+                    self._event_sink(events)
+                except Exception as err:  # noqa: BLE001 — thread boundary
+                    logger.error(
+                        "%s: event sink failed (cache may lag until "
+                        "resync): %s",
+                        self.name, err,
+                    )
             for event in events:
                 try:
                     self._fan_out(event)
@@ -275,6 +295,11 @@ class Controller:
                 self._queue.add(request)
 
     def _safe_relist(self) -> None:
+        if self._relist_sink is not None:
+            try:
+                self._relist_sink()
+            except Exception as err:  # noqa: BLE001 — thread boundary
+                logger.error("%s: relist sink failed: %s", self.name, err)
         try:
             self._enqueue_initial_list()
         except Exception as err:  # noqa: BLE001 — thread boundary

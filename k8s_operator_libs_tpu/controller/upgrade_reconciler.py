@@ -165,6 +165,7 @@ def new_upgrade_controller(
     failed_requeue_seconds: float = 5.0,
     gated_requeue_seconds: float = 5.0,
     watch_poll_seconds: float = 0.005,
+    feed_cache=None,
 ) -> Controller:
     """Assemble the standard operator: watches on Nodes, driver Pods,
     DaemonSets (and NodeMaintenance when requestor mode needs it via
@@ -172,7 +173,12 @@ def new_upgrade_controller(
 
     Pass either a fixed *policy* or a live *policy_source* (e.g.
     :class:`CrPolicySource`); with a source, the policy kind is watched
-    too, so CR edits wake the operator immediately."""
+    too, so CR edits wake the operator immediately.
+
+    *feed_cache*: an ``externally_fed`` :class:`~..cluster.InformerCache`
+    to tee every drained watch event into (the single-reflector rule —
+    one consumer feeds both cache and workqueue); its kinds are added to
+    the controller's watches so their frames flow."""
     if (policy is None) == (policy_source is None):
         raise ValueError("pass exactly one of policy / policy_source")
     if policy_source is not None and not callable(
@@ -199,10 +205,23 @@ def new_upgrade_controller(
         name="upgrade-controller",
         resync_seconds=resync_seconds,
         watch_poll_seconds=watch_poll_seconds,
+        event_sink=feed_cache.ingest if feed_cache is not None else None,
+        relist_sink=feed_cache.sync if feed_cache is not None else None,
     )
     kinds = ["Node", "Pod", "DaemonSet", *extra_kinds]
     if policy_source is not None:
         kinds.append(POLICY_KIND)
+    if feed_cache is not None:
+        # cache kinds must ride the SAME stream: watch them with a
+        # no-request mapper so their frames reach the sink
+        for kind in feed_cache.kinds or ():
+            if kind not in kinds:
+                controller.watches(kind, mapper=_null_mapper)
     for kind in kinds:
         controller.watches(kind, mapper=_singleton_mapper)
     return controller
+
+
+def _null_mapper(_obj) -> tuple:
+    """Watch a kind only to feed the cache tee — no reconcile request."""
+    return ()
