@@ -149,6 +149,18 @@ class Controller:
         if not self._watches:
             raise RuntimeError("controller has no watches")
         self._started = True
+        if self._relist_sink is not None:
+            # an externally-fed cache may have missed frames while NO
+            # controller drained the stream (HA failover gap, restart):
+            # a full resync before the watch threads start closes it —
+            # frames queued meanwhile re-apply under the cache's
+            # monotonic guard
+            try:
+                self._relist_sink()
+            except Exception as err:  # noqa: BLE001 — thread boundary
+                logger.error(
+                    "%s: startup relist sink failed: %s", self.name, err
+                )
         self._enqueue_initial_list()
         watcher = threading.Thread(
             target=self._watch_loop, name=f"{self.name}-watch", daemon=True
