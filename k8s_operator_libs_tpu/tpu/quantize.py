@@ -1,0 +1,98 @@
+"""Weight-only int8 quantization for serving.
+
+Autoregressive decode is HBM-bandwidth-bound: every step reads every
+weight once to produce one token, so the weight bytes ARE the step
+time.  Weight-only int8 halves them vs bf16 (quarters them vs fp32)
+with near-lossless accuracy: weights are stored as int8 with symmetric
+per-output-channel fp32 scales, and XLA fuses the dequantize into the
+consuming matmul — the int8 tensor is what lives in, and streams from,
+HBM.  (The MXU also has a native int8 path; weight-only keeps
+activations in bf16/fp32, which is the standard serving recipe.)
+
+The reference (a pure-Go K8s operator library) has no compute — this
+extends the TPU-side workload story (SURVEY §7): train in bf16/fp32,
+checkpoint, quantize once, serve int8.
+
+Contract: :func:`quantize_params_int8` maps a TinyLM param tree to a
+same-structure tree whose >=2-D float leaves become
+``{"q": int8, "s": fp32 per-output-channel scale}`` nodes;
+:func:`dequantize_params` restores floats (inside jit — so the fused
+dequant reads int8 from HBM); :func:`quantization_error` reports the
+worst relative error for tests/ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_quant_node(node: Any) -> bool:
+    return (
+        isinstance(node, dict)
+        and set(node.keys()) == {"q", "s"}
+    )
+
+
+def quantize_params_int8(params) -> Dict[str, Any]:
+    """Symmetric per-output-channel int8 quantization of every float
+    leaf with ndim >= 2 (matmul/embedding kernels).  1-D leaves
+    (LayerNorm scales, biases) stay float: they are a rounding error of
+    the total bytes and quantizing them costs accuracy for nothing."""
+
+    def q(leaf):
+        if not isinstance(leaf, jnp.ndarray) or leaf.ndim < 2:
+            return leaf
+        if leaf.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):
+            return leaf
+        f = leaf.astype(jnp.float32)
+        # per-output-channel: reduce over every axis but the last
+        axes = tuple(range(f.ndim - 1))
+        amax = jnp.max(jnp.abs(f), axis=axes, keepdims=True)
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        qv = jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8)
+        return {"q": qv, "s": scale.astype(jnp.float32)}
+
+    return jax.tree.map(q, params)
+
+
+def dequantize_params(qparams, dtype=jnp.float32):
+    """Restore a float param tree from :func:`quantize_params_int8`
+    output.  Call INSIDE jit: XLA fuses the cast+scale into the
+    consuming matmul, so HBM holds and streams the int8 tensor."""
+
+    def dq(node):
+        if _is_quant_node(node):
+            return (node["q"].astype(jnp.float32) * node["s"]).astype(dtype)
+        return node
+
+    return jax.tree.map(dq, qparams, is_leaf=_is_quant_node)
+
+
+def quantization_error(params, qparams) -> float:
+    """Worst per-tensor relative reconstruction error (fro-norm ratio)
+    across quantized leaves — the tests'/ops' accuracy observable."""
+    deq = dequantize_params(qparams)
+    worst = 0.0
+    flat, _ = jax.tree.flatten(params)
+    dflat, _ = jax.tree.flatten(deq)
+    for a, b in zip(flat, dflat):
+        if not isinstance(a, jnp.ndarray) or a.ndim < 2:
+            continue
+        af = a.astype(jnp.float32)
+        bf = b.astype(jnp.float32)
+        denom = float(jnp.linalg.norm(af.reshape(-1))) or 1.0
+        err = float(jnp.linalg.norm((af - bf).reshape(-1))) / denom
+        worst = max(worst, err)
+    return worst
+
+
+def quantized_bytes(qparams) -> int:
+    """Total parameter bytes as stored (int8 + scales + float
+    residue) — the HBM-footprint observable."""
+    total = 0
+    for leaf in jax.tree.leaves(qparams):
+        total += leaf.size * leaf.dtype.itemsize
+    return total

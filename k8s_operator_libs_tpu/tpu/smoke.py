@@ -141,12 +141,32 @@ def _decode_bench(config, params) -> Dict[str, Any]:
     out = greedy_generate(config, params, prompt, new_tokens)
     jax.block_until_ready(out)
     elapsed = _time.perf_counter() - t0
-    return {
+    result = {
         "batch": b,
         "new_tokens": new_tokens,
         "tokens_per_s": round(b * new_tokens / elapsed, 1),
         "ms_per_token": round(elapsed / new_tokens * 1e3, 3),
     }
+    # weight-only int8 (tpu/quantize.py): decode streams int8 weights
+    # from HBM — the bandwidth-bound serving win, plus token agreement
+    from .quantize import quantize_params_int8
+
+    qp = quantize_params_int8(params)
+    jax.block_until_ready(greedy_generate(config, qp, prompt, new_tokens))
+    t0 = _time.perf_counter()
+    out_q = greedy_generate(config, qp, prompt, new_tokens)
+    jax.block_until_ready(out_q)
+    elapsed_q = _time.perf_counter() - t0
+    import numpy as _np
+
+    result["int8"] = {
+        "tokens_per_s": round(b * new_tokens / elapsed_q, 1),
+        "speedup_vs_float": round(elapsed / elapsed_q, 3),
+        "token_agreement": round(
+            float((_np.asarray(out) == _np.asarray(out_q)).mean()), 3
+        ),
+    }
+    return result
 
 
 def run_smoke(

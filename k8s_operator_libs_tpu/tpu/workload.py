@@ -476,6 +476,17 @@ def greedy_generate(
             f"exceeds max_seq_len ({cfg.max_seq_len})"
         )
     model = TinyLM(cfg)
+    # weight-only int8 serving (tpu/quantize.py): detect a quantized
+    # tree and dequantize INSIDE the jitted loop — the int8 tensors are
+    # the jit inputs, so HBM holds/streams int8 and XLA fuses the
+    # cast+scale into each consuming matmul
+    from .quantize import _is_quant_node, dequantize_params
+
+    quantized = any(
+        _is_quant_node(n)
+        for n in jax.tree.leaves(params, is_leaf=_is_quant_node)
+        if isinstance(n, dict)
+    )
     # init-time input length sizes the per-layer cache buffers: size to
     # THIS generation's span, not max_seq_len — flax's decode attention
     # scores against every cached position each step, so an oversized
@@ -492,12 +503,14 @@ def greedy_generate(
     memo_key = (
         cfg.vocab_size, cfg.d_model, cfg.n_heads, cfg.n_layers, cfg.d_ff,
         cfg.max_seq_len, cfg.n_experts, str(cfg.dtype), b, prompt_len,
-        total,
+        total, quantized,
     )
     run = _decode_loop_cache.get(memo_key)
     if run is None:
 
         def run_impl(p, cache, buf):
+            if quantized:
+                p = dequantize_params(p, cfg.dtype)
             def step(carry, i):
                 cache_c, buf_c = carry
                 token = jax.lax.dynamic_slice_in_dim(buf_c, i, 1, axis=1)
