@@ -443,18 +443,39 @@ def greedy_generate(
     prompt,
     max_new_tokens: int,
 ):
-    """KV-cache greedy decoding — the serving path.
+    """KV-cache GREEDY decoding — :func:`generate` at temperature 0."""
+    return generate(config, params, prompt, max_new_tokens)
+
+
+def generate(
+    config: ModelConfig,
+    params,
+    prompt,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    seed: int = 0,
+):
+    """KV-cache decoding — the serving path.
 
     Runs TinyLM one token at a time in flax decode mode: each step's
     K/V lands in the per-layer cache (write at the cache index, no
     recompute of the prefix), so a T-token generation is O(T·seq)
     attention work instead of the O(T·seq²) of full-prefix recompute.
     Trained weights drop in unchanged (the cache is a separate flax
-    collection; the param tree is identical to training mode).
+    collection; the param tree is identical to training mode); a
+    weight-only int8 tree from :mod:`.quantize` drops in too.
+
+    Sampling: ``temperature <= 0`` is greedy argmax; ``temperature >
+    0`` samples the softmax at that temperature, restricted to the
+    ``top_k`` highest-probability tokens when ``top_k > 0``.  *seed*
+    pins the sample stream (per-step keys are folded from it), so a
+    (seed, prompt) pair reproduces its continuation exactly.
 
     *prompt* is [batch, prompt_len] int32 (one shared prompt length);
     returns [batch, prompt_len + max_new_tokens] — prompt tokens are
-    teacher-forced, the rest greedy-argmax.  The whole loop is one
+    teacher-forced, the rest decoded.  The whole loop is one
     ``lax.scan`` under jit: static shapes, no host round trips per
     token.  Decode mode is the unsharded per-chip path (serving
     replicates by batch); MoE configs are supported, sharded/ring modes
@@ -500,17 +521,19 @@ def greedy_generate(
     # one jitted loop per (shape, config) signature: a fresh closure
     # per call would defeat jax's jit cache and re-trace every
     # generation — fatal for a serving path
+    do_sample = temperature > 0.0
     memo_key = (
         cfg.vocab_size, cfg.d_model, cfg.n_heads, cfg.n_layers, cfg.d_ff,
         cfg.max_seq_len, cfg.n_experts, str(cfg.dtype), b, prompt_len,
-        total, quantized,
+        total, quantized, do_sample, top_k,
     )
     run = _decode_loop_cache.get(memo_key)
     if run is None:
 
-        def run_impl(p, cache, buf):
+        def run_impl(p, cache, buf, temp, key):
             if quantized:
                 p = dequantize_params(p, cfg.dtype)
+
             def step(carry, i):
                 cache_c, buf_c = carry
                 token = jax.lax.dynamic_slice_in_dim(buf_c, i, 1, axis=1)
@@ -520,10 +543,20 @@ def greedy_generate(
                     positions=jnp.full((b, 1), i, jnp.int32),
                     mutable=["cache"],
                 )
-                nxt = jnp.argmax(
-                    logits[:, -1].astype(jnp.float32), axis=-1
-                )
-                # teacher-force inside the prompt; greedy beyond it
+                last = logits[:, -1].astype(jnp.float32)
+                if do_sample:
+                    scaled = last / temp
+                    if top_k > 0:
+                        # keep only the top_k logits per row: everything
+                        # below the k-th largest is masked out
+                        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+                        scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+                    nxt = jax.random.categorical(
+                        jax.random.fold_in(key, i), scaled, axis=-1
+                    )
+                else:
+                    nxt = jnp.argmax(last, axis=-1)
+                # teacher-force inside the prompt; decode beyond it
                 inside = i + 1 < prompt_len
                 current = jax.lax.dynamic_slice_in_dim(
                     buf_c, i + 1, 1, axis=1
@@ -543,7 +576,13 @@ def greedy_generate(
         if len(_decode_loop_cache) >= 64:
             _decode_loop_cache.clear()
         _decode_loop_cache[memo_key] = run
-    return run(params, cache, buf)
+    return run(
+        params,
+        cache,
+        buf,
+        jnp.asarray(max(temperature, 1e-6), jnp.float32),
+        jax.random.key(seed),
+    )
 
 
 def make_batch(config: ModelConfig, batch_size: int, seed: int = 0):
