@@ -60,6 +60,7 @@ from .errors import (
     UnauthorizedError,
 )
 from .inmem import InMemoryCluster, JsonObj
+from .selectors import parse_selector
 
 logger = logging.getLogger(__name__)
 
@@ -297,15 +298,46 @@ class _Handler(BaseHTTPRequestHandler):
     #: bounded-poll shim's synchronous contract.
     HELD_WATCH_MIN_TIMEOUT = 2.0
 
-    def _encode_watch_frames(self, info: KindInfo, events) -> list:
+    @staticmethod
+    def _selector_transition(ev, match) -> Optional[str]:
+        """Watch-cache selector semantics: the frame TYPE depends on the
+        selector-match transition, not just the store operation —
+        an object that STOPS matching emits DELETED (the watcher must
+        drop it from its view), one that STARTS matching emits ADDED."""
+        labels_of = lambda o: (  # noqa: E731
+            ((o or {}).get("metadata") or {}).get("labels") or {}
+        )
+        old_m = ev.old is not None and match(labels_of(ev.old))
+        new_m = ev.new is not None and match(labels_of(ev.new))
+        if ev.type == "Added":
+            return "ADDED" if new_m else None
+        if ev.type == "Deleted":
+            return "DELETED" if old_m else None
+        # Modified
+        if old_m and new_m:
+            return "MODIFIED"
+        if old_m and not new_m:
+            return "DELETED"
+        if new_m and not old_m:
+            return "ADDED"
+        return None
+
+    def _encode_watch_frames(self, info: KindInfo, events, match=None) -> list:
         frames = []
         for ev in events:
             obj = ev.new if ev.new is not None else ev.old
             if obj is None:
                 continue
-            type_ = {"Added": "ADDED", "Modified": "MODIFIED", "Deleted": "DELETED"}[
-                ev.type
-            ]
+            if match is not None:
+                type_ = self._selector_transition(ev, match)
+                if type_ is None:
+                    continue
+            else:
+                type_ = {
+                    "Added": "ADDED",
+                    "Modified": "MODIFIED",
+                    "Deleted": "DELETED",
+                }[ev.type]
             # DELETED frames carry the last object state, with the journal
             # seq as its resourceVersion so the watcher can advance.
             obj = dict(obj)
@@ -342,16 +374,24 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError:
             timeout_s = 0.0
         bookmarks = query.get("allowWatchBookmarks") in ("true", "1")
+        # server-side filtered watch (client-go ListOptions.LabelSelector
+        # on watches): non-matching frames never cross the wire, and
+        # selector transitions rewrite the frame type (see
+        # _selector_transition)
+        selector = query.get("labelSelector", "")
+        match = parse_selector(selector) if selector else None
         # Head BEFORE the scan (the Controller._watch_loop ordering): a
         # write landing between the two reads is then past the bookmark
         # and redelivered next poll — bookmarking a post-scan head would
         # let the client skip it forever.
         head = self.cluster.journal_seq()
         events = self.cluster.events_since(seq, kind=info.kind)
-        frames = self._encode_watch_frames(info, events)
+        frames = self._encode_watch_frames(info, events, match)
         position = max([head] + [ev.seq for ev in events])
         if timeout_s > self.HELD_WATCH_MIN_TIMEOUT:
-            self._serve_held_watch(info, frames, position, timeout_s, bookmarks)
+            self._serve_held_watch(
+                info, frames, position, timeout_s, bookmarks, match
+            )
             return
         if bookmarks:
             # Closing BOOKMARK (real apiservers send one when a timed-out
@@ -372,6 +412,7 @@ class _Handler(BaseHTTPRequestHandler):
         position: int,
         timeout_s: float,
         bookmarks: bool,
+        match=None,
     ) -> None:
         """Stream frames as they land until *timeout_s* expires — the
         held-stream contract real apiservers provide.  Termination is
@@ -415,11 +456,12 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 cursor = max(cursor, head)
                 if events:
-                    frames = self._encode_watch_frames(info, events)
+                    frames = self._encode_watch_frames(info, events, match)
                     position = max(position, max(ev.seq for ev in events))
                     cursor = max(cursor, position)
-                    self.wfile.write(("\n".join(frames) + "\n").encode())
-                    self.wfile.flush()
+                    if frames:
+                        self.wfile.write(("\n".join(frames) + "\n").encode())
+                        self.wfile.flush()
             if bookmarks:
                 self.wfile.write(
                     (

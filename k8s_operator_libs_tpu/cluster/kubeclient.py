@@ -347,6 +347,9 @@ class KubeApiClient:
         )
         #: APF load-shed 429s transparently replayed after Retry-After.
         self.overload_retries = 0
+        #: Per-kind watch label selectors (start_held_watches) — ride
+        #: every watch request for that kind, held or bounded.
+        self._watch_selectors: Dict[str, str] = {}
         parsed = urlparse(config.server)
         self._scheme = parsed.scheme or "http"
         self._host = parsed.hostname or "localhost"
@@ -978,6 +981,9 @@ class KubeApiClient:
                 # socket timeout and discards streamed frames
                 "timeoutSeconds": str(self.watch_timeout_seconds),
             }
+            sel = self._watch_selectors.get(k)
+            if sel:
+                query["labelSelector"] = sel
             try:
                 raw = self._request_watch(info, query)
             except NotFoundError:
@@ -1088,12 +1094,17 @@ class KubeApiClient:
 
     def _seed_last_seen(self, kind: str) -> None:
         """First touch of a kind: list it so every pre-existing object
-        has a last-seen entry (the informer's initial list)."""
+        has a last-seen entry (the informer's initial list) — scoped by
+        the kind's watch selector when one is set, matching the stream's
+        view."""
         with self._last_seen_lock:
             if kind in self._seeded_kinds:
                 return
         try:
-            items = self.list(kind)
+            items = self.list(
+                kind,
+                label_selector=self._watch_selectors.get(kind, ""),
+            )
         except (NotFoundError, ApiError):
             items = []  # not served yet; seeding retries next call
         else:
@@ -1156,7 +1167,10 @@ class KubeApiClient:
 
     # ---------------------------------------------------------- held watches
     def start_held_watches(
-        self, kinds, hold_seconds: float = 20.0
+        self,
+        kinds,
+        hold_seconds: float = 20.0,
+        label_selectors: Optional[Dict[str, str]] = None,
     ) -> None:
         """Switch *kinds* from bounded polling to HELD watch streams —
         one background thread per kind keeps a long watch open (the
@@ -1176,6 +1190,13 @@ class KubeApiClient:
         wanted = frozenset(kinds)
         for k in sorted(wanted):
             kind_info(k)  # fail fast on unregistered kinds, state untouched
+        # server-side filtered watches (client-go ListOptions.
+        # LabelSelector): per-kind selectors ride every watch request —
+        # non-matching objects' frames never cross the wire, and the
+        # server rewrites frame types on selector transitions (an object
+        # that stops matching arrives as DELETED).  The informer's view
+        # for that kind is then the MATCHING subset only.
+        self._watch_selectors = dict(label_selectors or {})
         # Seed every kind SYNCHRONOUSLY, before any watcher thread exists:
         # the seed list pins the kind's bookmark in THIS thread, so a write
         # issued after start_held_watches() returns is strictly past the
@@ -1488,6 +1509,9 @@ class _HeldWatcher(threading.Thread):
             "allowWatchBookmarks": "true",
             "timeoutSeconds": str(self._hold),
         }
+        sel = client._watch_selectors.get(self._kind)
+        if sel:
+            query["labelSelector"] = sel
         path = f"{info.path()}?{urlencode(query)}"
         cred = client._refresh_auth(None)
         conn = self._open_connection()

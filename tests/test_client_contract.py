@@ -2958,3 +2958,101 @@ class TestCacheBackedReads:
             listed_kinds
         )
         assert listed_kinds.count("DaemonSet") <= 2, listed_kinds
+
+
+class TestFilteredWatch:
+    """Server-side label-filtered watches (client-go's
+    ListOptions.LabelSelector on watch): non-matching frames never
+    cross the wire, and selector TRANSITIONS rewrite the frame type —
+    an object that stops matching arrives as DELETED, one that starts
+    matching as ADDED (the apiserver watch-cache contract)."""
+
+    def _mk_pod(self, name, app):
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": name, "namespace": "d",
+                         "labels": {"app": app}},
+            "spec": {"nodeName": "n1"},
+            "status": {"phase": "Running"},
+        }
+
+    def test_held_stream_delivers_matching_only_with_transitions(self):
+        store = InMemoryCluster()
+        with ApiServerFacade(store) as facade:
+            client = KubeApiClient(KubeConfig(server=facade.url), timeout=10.0)
+            client.create(self._mk_pod("driver-1", "driver"))
+            client.create(self._mk_pod("noise-1", "other"))
+            client.start_held_watches(
+                ("Pod",), hold_seconds=3.0,
+                label_selectors={"Pod": "app=driver"},
+            )
+            try:
+                seq0 = client.journal_seq()
+                # churn: matching create, noise create, a transition OUT
+                # and a transition IN
+                client.create(self._mk_pod("driver-2", "driver"))
+                client.create(self._mk_pod("noise-2", "other"))
+                client.patch(
+                    "Pod", "driver-1",
+                    {"metadata": {"labels": {"app": "other"}}},
+                    namespace="d",
+                )  # stops matching -> DELETED
+                client.patch(
+                    "Pod", "noise-1",
+                    {"metadata": {"labels": {"app": "driver"}}},
+                    namespace="d",
+                )  # starts matching -> ADDED
+                deadline = time.monotonic() + 5.0
+                seen = []
+                while time.monotonic() < deadline and len(seen) < 3:
+                    for ev in client.events_since(seq0, kind="Pod"):
+                        name = (
+                            (ev.new or ev.old or {})
+                            .get("metadata", {})
+                            .get("name")
+                        )
+                        seen.append((ev.type, name))
+                    time.sleep(0.05)
+            finally:
+                client.stop_held_watches()
+        kinds_seen = {n for _t, n in seen}
+        assert "noise-2" not in kinds_seen, seen
+        assert ("Added", "driver-2") in seen, seen
+        assert ("Deleted", "driver-1") in seen, seen
+        assert ("Added", "noise-1") in seen, seen
+
+    def test_seed_list_is_selector_scoped(self):
+        """The informer's initial list rides the same selector, so the
+        old-object synthesis view only tracks matching objects."""
+        store = InMemoryCluster()
+        with ApiServerFacade(store) as facade:
+            client = KubeApiClient(KubeConfig(server=facade.url), timeout=10.0)
+            client.create(self._mk_pod("driver-1", "driver"))
+            client.create(self._mk_pod("noise-1", "other"))
+            client.start_held_watches(
+                ("Pod",), hold_seconds=3.0,
+                label_selectors={"Pod": "app=driver"},
+            )
+            try:
+                with client._last_seen_lock:
+                    names = {
+                        k[2] for k in client._last_seen if k[0] == "Pod"
+                    }
+            finally:
+                client.stop_held_watches()
+        assert "driver-1" in names and "noise-1" not in names
+
+    def test_bounded_poll_honors_selector(self):
+        store = InMemoryCluster()
+        with ApiServerFacade(store) as facade:
+            client = KubeApiClient(KubeConfig(server=facade.url), timeout=10.0)
+            client._watch_selectors = {"Pod": "app=driver"}
+            seq0 = client.journal_seq()
+            client.create(self._mk_pod("driver-1", "driver"))
+            client.create(self._mk_pod("noise-1", "other"))
+            events = client.events_since(seq0, kind="Pod")
+            names = [
+                (e.new or {}).get("metadata", {}).get("name") for e in events
+            ]
+        assert names == ["driver-1"], names
