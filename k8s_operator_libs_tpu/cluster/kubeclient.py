@@ -328,6 +328,7 @@ class _TokenBucket:
             self._tokens = 0.0
             self._stamp = now + need  # the refill we are pre-spending
             self.waited_seconds += need
+        metrics.record_client_throttle_wait(need)
         time.sleep(need)
 
 
@@ -584,6 +585,7 @@ class KubeApiClient:
         ):
             attempts += 1
             self.overload_retries += 1
+            metrics.record_overload_retry()
             try:
                 delay = float(resp.getheader("Retry-After") or 1.0)
             except ValueError:

@@ -396,6 +396,25 @@ def record_watch_reconnect(kind: str) -> None:
     ).inc(kind)
 
 
+def record_client_throttle_wait(seconds: float) -> None:
+    """A request blocked in the client-side token bucket (KubeConfig
+    qps/burst) — cumulative seconds, the client-go "Waited for Xs due
+    to client-side throttling" observable as a metric."""
+    default_registry().counter(
+        "client_throttle_wait_seconds_total",
+        "Seconds requests spent blocked in the client-side rate limiter.",
+    ).inc(amount=seconds)
+
+
+def record_overload_retry() -> None:
+    """The apiserver shed this request with an APF 429 and the client
+    replayed it after Retry-After."""
+    default_registry().counter(
+        "client_overload_retries_total",
+        "APF load-shed 429s transparently replayed by the client.",
+    ).inc()
+
+
 def record_watch_expired(kind: str) -> None:
     """A watch position fell out of the server's retention window (410)."""
     default_registry().counter(
