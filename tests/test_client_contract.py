@@ -3056,3 +3056,115 @@ class TestFilteredWatch:
                 (e.new or {}).get("metadata", {}).get("name") for e in events
             ]
         assert names == ["driver-1"], names
+
+
+class TestOverloadedThrottledRollout:
+    """Composition soak: a full rollout with APF load shedding
+    (1-seat max-in-flight), client-side qps throttling, AND random
+    connection drops — all three defense layers at once.  The manager's
+    own loop is sequential (instrumented peak concurrency is 1), so a
+    background hammer thread supplies the overload: the apiserver must
+    SHED it while the rollout still converges, with throttling and
+    shedding actually observed (a vacuously-green run proves
+    nothing)."""
+
+    def test_rollout_converges_under_all_three(self):
+        from k8s_operator_libs_tpu.api import (
+            DrainSpec,
+            IntOrString,
+            UpgradePolicySpec,
+        )
+        from k8s_operator_libs_tpu.upgrade import consts
+        from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+            ClusterUpgradeStateManager,
+        )
+
+        from harness import DRIVER_LABELS, NAMESPACE, Fleet
+
+        store = InMemoryCluster()
+        # slow the store's list path slightly so concurrent drain
+        # workers genuinely overlap on the server — otherwise sub-ms
+        # handlers rarely hold 2 seats at once and the shedding
+        # assertion below would be flaky
+        orig_list = store.list
+
+        def slow_list(*a, **kw):
+            time.sleep(0.005)
+            return orig_list(*a, **kw)
+
+        store.list = slow_list
+        facade = ApiServerFacade(store, max_inflight=1).with_chaos(0.03)
+        facade.start()
+        client = KubeApiClient(
+            KubeConfig(server=facade.url, qps=300.0, burst=30),
+            timeout=10.0,
+        )
+        try:
+            fleet = Fleet(client)
+            for i in range(8):
+                fleet.add_node(f"n{i}", pod_hash="rev1")
+            fleet.publish_new_revision("rev2")
+            # the overload: concurrent listers hammering throughout the
+            # rollout (their own client — the rollout client's token
+            # bucket must not pace them)
+            import threading as _threading
+
+            hammer_client = KubeApiClient(
+                KubeConfig(server=facade.url), timeout=10.0
+            )
+            hammer_stop = _threading.Event()
+
+            def hammer():
+                while not hammer_stop.is_set():
+                    try:
+                        hammer_client.list("Node")
+                    except Exception:  # noqa: BLE001 — chaos drops
+                        pass
+
+            hammer_threads = [
+                _threading.Thread(target=hammer) for _ in range(4)
+            ]
+            for t in hammer_threads:
+                t.start()
+            manager = ClusterUpgradeStateManager(
+                client,
+                cache_sync_timeout_seconds=5.0,
+                cache_sync_poll_seconds=0.01,
+            )
+            policy = UpgradePolicySpec(
+                auto_upgrade=True,
+                max_parallel_upgrades=0,
+                max_unavailable=IntOrString("100%"),
+                drain_spec=DrainSpec(
+                    enable=True, force=True, timeout_second=10
+                ),
+            )
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+                manager.apply_state(state, policy)
+                manager.drain_manager.wait_idle(10.0)
+                manager.pod_manager.wait_idle(10.0)
+                fleet.reconcile_daemonset()
+                if set(fleet.states().values()) == {
+                    consts.UPGRADE_STATE_DONE
+                }:
+                    break
+                time.sleep(0.01)
+            assert set(fleet.states().values()) == {
+                consts.UPGRADE_STATE_DONE
+            }, fleet.states()
+        finally:
+            try:
+                hammer_stop.set()
+                for t in hammer_threads:
+                    t.join(timeout=10)
+            except NameError:
+                pass  # failed before the hammer started
+            facade.stop()
+        # all three layers genuinely engaged
+        assert facade.apf_state["rejected"] > 0, "APF never shed"
+        assert hammer_client.overload_retries > 0, (
+            "the hammer never got replayed 429s"
+        )
+        assert client.throttle_waited_seconds > 0, "throttle never engaged"
