@@ -335,12 +335,15 @@ def _flash_bwd_dkv_kernel(
 
 def _flash_backward(
     q, k, v, o, lse, dout, causal: bool, block_q: int, block_k: int,
-    interpret: bool,
+    interpret: bool, g_lse=None,
 ):
     """Fused flash backward: (dq, dk, dv) with O(seq) memory.  GQA: the
     kernels run over QUERY heads (K/V tiles shared via the index map,
     like the forward) producing per-query-head dK/dV partials, which a
-    cheap XLA reshape-sum reduces over each group."""
+    cheap XLA reshape-sum reduces over each group.  *g_lse* (the lse
+    output's cotangent, [b*h, s]) folds into the row term: ds_ij =
+    p_ij (dp_ij - D_i + glse_i), so dvec = D - g_lse and the kernels
+    run unchanged."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -356,6 +359,8 @@ def _flash_backward(
     # D_i = sum_j P_ij dP_ij = rowsum(dO ∘ O): a cheap XLA elementwise
     # reduction — no reason to burn kernel VMEM on it
     dvec = (fold(o).astype(jnp.float32) * dof.astype(jnp.float32)).sum(-1)
+    if g_lse is not None:
+        dvec = dvec - g_lse.astype(jnp.float32)
 
     common = dict(
         block_q=block_q, block_k=block_k, causal=causal, scale=scale
@@ -526,6 +531,45 @@ def _flash_bwd(causal, block_q, block_k, interpret, backward, residuals, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_lse(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Flash attention returning ``(out, lse)`` — *lse* is the per-row
+    logsumexp of the scaled scores, shape [batch*heads, seq] fp32.
+    This is the PARTIAL-attention building block: two normalized
+    partials over disjoint key sets merge exactly via their lse
+    (ring attention's cross-chip combine).  Fully differentiable in
+    BOTH outputs: an lse cotangent folds into the fused backward as
+    ``dvec - g_lse`` (d lse_i / d s_ij = p_ij, the same probability
+    tile the kernels already re-derive), so the backward kernels run
+    unchanged."""
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v, o, lse = residuals
+    g_out, g_lse = g
+    return _flash_backward(
+        q, k, v, o, lse, g_out, causal, block_q, block_k, interpret,
+        g_lse=g_lse,
+    )
+
+
+flash_attention_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def make_flash_attention_fn(

@@ -139,6 +139,96 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+def ring_flash_attention(
+    q, k, v, axis_name: str, causal: bool = True, block: int = 128
+):
+    """Ring attention with the PER-CHIP Pallas flash kernel as the
+    block-pair engine — the full long-context composition: the ring
+    rotates K/V across chips (O(seq/sp) per-chip K/V residency), and
+    each pair runs the flash kernel (O(block) VMEM, never a
+    [seq_local, seq_local] score matrix — which the einsum ring pays at
+    4 GiB fp32 per head-batch for a 32k local sequence).
+
+    Per ring step the kernel returns a NORMALIZED partial and its
+    logsumexp; partials over disjoint key sets merge exactly in the lse
+    frame:  L = logaddexp(L, lse_p);  o = o*exp(L_old-L) +
+    o_p*exp(lse_p-L).  Causal with contiguous chunks: pairs strictly
+    below the diagonal run the kernel UNMASKED, the diagonal pair runs
+    it causal, pairs above are skipped without compute (lax.cond).
+    Differentiable end-to-end — the lse output carries its own
+    cotangent through the fused flash backward (flash_attention_lse).
+
+    Same contract as :func:`ring_attention` (inside shard_map,
+    per-device shards, contiguous chunks); *block* must divide the
+    local sequence — callers fall back to the einsum ring otherwise."""
+    from .flash_attention import flash_attention_lse
+
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    if s_loc % min(block, s_loc):
+        raise ValueError(
+            f"ring_flash_attention needs block ({block}) to divide the "
+            f"local sequence ({s_loc})"
+        )
+    blk = min(block, s_loc)
+    interpret = jax.devices()[0].platform != "tpu"
+
+    o0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    lse0 = jnp.full((b * h, s_loc), _NEG, jnp.float32)
+
+    def merge(o, lse, o_p, lse_p):
+        lse_new = jnp.logaddexp(lse, lse_p)  # [b*h, s]
+        w_old = jnp.exp(lse - lse_new).reshape(b, h, s_loc)
+        w_new = jnp.exp(lse_p - lse_new).reshape(b, h, s_loc)
+        o_new = (
+            o * w_old.transpose(0, 2, 1)[..., None]
+            + o_p.astype(jnp.float32) * w_new.transpose(0, 2, 1)[..., None]
+        )
+        return o_new, lse_new
+
+    def step(carry, i):
+        o, lse, k_blk, v_blk = carry
+        src = (my - i) % n  # ring position this K/V block came from
+
+        def pair(causal_pair: bool):
+            def run(operands):
+                o, lse, k_blk, v_blk = operands
+                o_p, lse_p = flash_attention_lse(
+                    q, k_blk, v_blk, causal_pair, blk, blk, interpret
+                )
+                return merge(o, lse, o_p, lse_p)
+
+            return run
+
+        def skip(operands):
+            o, lse, _k, _v = operands
+            return o, lse
+
+        if causal:
+            # below-diagonal: full unmasked pair; diagonal: causal pair;
+            # above-diagonal: no compute at all
+            o, lse = jax.lax.cond(
+                src < my,
+                pair(False),
+                lambda ops: jax.lax.cond(
+                    src == my, pair(True), skip, ops
+                ),
+                (o, lse, k_blk, v_blk),
+            )
+        else:
+            o, lse = pair(False)((o, lse, k_blk, v_blk))
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o, lse, k_blk, v_blk), None
+
+    (o, lse, _, _), _ = jax.lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(n)
+    )
+    return o.astype(q.dtype)
+
+
 def ring_attention_sharded(
     q,
     k,
@@ -148,6 +238,8 @@ def ring_attention_sharded(
     batch_axis: Optional[str] = "data",
     heads_axis: Optional[str] = None,
     causal: bool = True,
+    use_flash: bool = False,
+    flash_block: int = 128,
 ):
     """`shard_map` wrapper: global [batch, seq, heads, head_dim] arrays
     sharded (batch over *batch_axis*, seq over *seq_axis*, and — when
@@ -158,7 +250,12 @@ def ring_attention_sharded(
     independent, so each model-group device rings over ITS head subset
     — without it, entering the shard_map would all-gather q/k/v over
     the model axis and every tp peer would redo the full-head
-    attention."""
+    attention.
+
+    *use_flash* swaps the per-pair einsum engine for the Pallas flash
+    kernel (:func:`ring_flash_attention`) — O(block) VMEM per chip
+    instead of a [seq_local, seq_local] score matrix; *flash_block*
+    must divide the local sequence."""
     try:
         from jax import shard_map  # jax >= 0.8
         kw = {"check_vma": False}
@@ -167,7 +264,17 @@ def ring_attention_sharded(
         kw = {"check_rep": False}
 
     spec = P(batch_axis, seq_axis, heads_axis, None)
-    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    if use_flash:
+        fn = functools.partial(
+            ring_flash_attention,
+            axis_name=seq_axis,
+            causal=causal,
+            block=flash_block,
+        )
+    else:
+        fn = functools.partial(
+            ring_attention, axis_name=seq_axis, causal=causal
+        )
     return shard_map(
         fn,
         mesh=mesh,

@@ -64,6 +64,14 @@ class ModelConfig:
     #: param tree either way (the flax ``attention_fn`` seam), so the
     #: two modes are exactly comparable on identical weights.
     ring_attention: bool = False
+    #: With ``ring_attention``: run each ring block-pair through the
+    #: Pallas flash kernel instead of the einsum online-softmax —
+    #: O(block) VMEM per chip (no [seq_local, seq_local] score matrix),
+    #: partials merged exactly in the logsumexp frame, differentiable
+    #: end-to-end (ring_attention.ring_flash_attention).  Needs the
+    #: flash block (128) to divide the local sequence; falls back to
+    #: the einsum ring loudly otherwise.
+    ring_flash: bool = False
     #: Per-chip Pallas flash attention (:mod:`.flash_attention`): the
     #: kernel streams K/V blocks through VMEM with the online-softmax
     #: accumulator and prunes the causal k-loop — never materializing
@@ -218,6 +226,19 @@ class Block(nn.Module):
                 heads_axis = (
                     "model" if tp > 1 and query.shape[2] % tp == 0 else None
                 )
+                use_flash = cfg.ring_flash
+                if use_flash:
+                    s_loc = query.shape[1] // ring_mesh.shape[cfg.seq_axis]
+                    blk = min(128, s_loc)
+                    if blk <= 0 or s_loc % blk:
+                        _logging.getLogger(__name__).warning(
+                            "ring_flash: flash block %d does not divide "
+                            "the local sequence %d — falling back to the "
+                            "einsum ring for this shape",
+                            blk,
+                            s_loc,
+                        )
+                        use_flash = False
                 return ring_attention_sharded(
                     query,
                     key,
@@ -226,6 +247,8 @@ class Block(nn.Module):
                     cfg.seq_axis,
                     heads_axis=heads_axis,
                     causal=True,
+                    use_flash=use_flash,
+                    flash_block=min(128, max(1, query.shape[1] // ring_mesh.shape[cfg.seq_axis])),
                 )
 
         elif cfg.flash_attention and (
