@@ -132,11 +132,20 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt: str, *args) -> None:  # noqa: A003
         logger.debug("facade: " + fmt, *args)
 
-    def _read_body(self) -> Optional[JsonObj]:
+    def _drain_body(self) -> None:
+        """Consume the request body BEFORE any dispatch decision.  On a
+        keep-alive connection every unread body byte is parsed as the
+        NEXT request line — an early rejection (APF 429, 401, bad
+        route) that skipped the body desynchronized the whole
+        connection ('Bad request syntax' on the following request;
+        found by the overload soak)."""
         length = int(self.headers.get("Content-Length") or 0)
-        if not length:
+        self._raw_body = self.rfile.read(length) if length else b""
+
+    def _read_body(self) -> Optional[JsonObj]:
+        raw = getattr(self, "_raw_body", b"")
+        if not raw:
             return None
-        raw = self.rfile.read(length)
         try:
             return json.loads(raw)
         except json.JSONDecodeError as err:
@@ -180,6 +189,7 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
             return
         try:
+            self._drain_body()
             self._check_auth()
             (info, namespace, name, subresource), query = self._route()
             # Priority-and-fairness max-in-flight: a real apiserver sheds
