@@ -82,6 +82,10 @@ class InformerCache:
         self._snapshot: Dict[Key, JsonObj] = {}
         self._last_seq = 0
         self._last_sync = float("-inf")
+        #: set ONLY by sync() — the externally-fed seeding check must
+        #: not be satisfied by an ingested delta batch (deltas atop an
+        #: unseeded view would silently miss every pre-existing object)
+        self._seeded = False
         #: full relists performed (observable: tests assert refreshes are
         #: incremental, ops can spot expiry churn)
         self.full_syncs = 0
@@ -109,6 +113,7 @@ class InformerCache:
                 self._snapshot = snap
                 self._last_seq = seq
                 self._last_sync = time.monotonic()
+                self._seeded = True
                 self.full_syncs += 1
 
     def _refresh(self) -> None:
@@ -142,6 +147,14 @@ class InformerCache:
             for ev in events:
                 obj = ev.new if ev.new is not None else ev.old
                 if obj is None:
+                    continue
+                if (
+                    self._kinds is not None
+                    and obj.get("kind") not in self._kinds
+                ):
+                    # a kinds-scoped cache must not accumulate objects
+                    # _check_kind forbids ever reading (an external
+                    # feeder may watch more kinds than we cache)
                     continue
                 meta = obj.get("metadata") or {}
                 key = (
@@ -184,9 +197,11 @@ class InformerCache:
     def _maybe_refresh(self) -> None:
         if self.externally_fed:
             # the external feeder owns journal consumption; reads only
-            # trigger the one-time seeding list
+            # trigger the one-time seeding list (an ingested delta
+            # batch must NOT satisfy this — deltas atop an unseeded
+            # view silently miss every pre-existing object)
             with self._lock:
-                seeded = self._last_sync != float("-inf")
+                seeded = self._seeded
             if not seeded:
                 self.sync()
             return

@@ -458,6 +458,9 @@ def make_train_step(model: TinyLM, tx, mesh: Optional[Mesh] = None):
 #: jitted decode loops keyed by (config, batch, prompt_len, total) —
 #: see greedy_generate
 _decode_loop_cache: dict = {}
+#: eval_shape'd cache-collection templates (same keying, minus
+#: prompt_len/sampling — the buffers depend only on (config, b, total))
+_decode_cache_shapes: dict = {}
 
 
 def greedy_generate(
@@ -534,10 +537,28 @@ def generate(
     # init-time input length sizes the per-layer cache buffers: size to
     # THIS generation's span, not max_seq_len — flax's decode attention
     # scores against every cached position each step, so an oversized
-    # cache multiplies both memory and per-step FLOPs
-    cache = model.init(
-        jax.random.key(0), jnp.zeros((b, total), jnp.int32)
-    )["cache"]
+    # cache multiplies both memory and per-step FLOPs.  Flax
+    # initializes every cache leaf to zeros, so the buffers are built
+    # from eval_shape'd (memoized) shapes — running model.init for
+    # real would re-initialize all weights and run a forward pass per
+    # serving call just to discard everything but ["cache"].
+    cache_key = (
+        cfg.vocab_size, cfg.d_model, cfg.n_heads, cfg.n_layers, cfg.d_ff,
+        cfg.max_seq_len, cfg.n_experts, str(cfg.dtype), b, total,
+    )
+    cache_shapes = _decode_cache_shapes.get(cache_key)
+    if cache_shapes is None:
+        cache_shapes = jax.eval_shape(
+            lambda: model.init(
+                jax.random.key(0), jnp.zeros((b, total), jnp.int32)
+            )["cache"]
+        )
+        if len(_decode_cache_shapes) >= 64:
+            _decode_cache_shapes.clear()
+        _decode_cache_shapes[cache_key] = cache_shapes
+    cache = jax.tree.map(
+        lambda sd: jnp.zeros(sd.shape, sd.dtype), cache_shapes
+    )
 
     buf = jnp.zeros((b, total), jnp.int32).at[:, :prompt_len].set(prompt)
 
