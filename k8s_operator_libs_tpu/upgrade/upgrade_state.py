@@ -297,6 +297,15 @@ class ClusterUpgradeStateManager:
         filtered.extend(common.get_orphaned_pods(pods))
 
         state_label = util.get_upgrade_state_label_key()
+        # one snapshot Node list instead of a per-pod cache get: at 4k
+        # nodes that is one store-lock acquisition per cycle, not 4k
+        # (same source the per-node read would hit — the reader when
+        # cache-backed, else the cluster the lag-0 cache passes through
+        # to — so the snapshot semantics are unchanged)
+        nodes_by_name = {
+            (n.get("metadata") or {}).get("name", ""): n
+            for n in self._reader.list("Node")
+        }
         for pod in filtered:
             owner_ds = None
             if not common.is_orphaned_pod(pod):
@@ -309,7 +318,9 @@ class ClusterUpgradeStateManager:
                     pod["metadata"]["name"],
                 )
                 continue
-            node_state = self._build_node_upgrade_state(pod, owner_ds)
+            node_state = self._build_node_upgrade_state(
+                pod, owner_ds, nodes_by_name
+            )
             bucket = ((node_state.node.get("metadata") or {}).get("labels") or {}).get(
                 state_label, consts.UPGRADE_STATE_UNKNOWN
             )
@@ -317,18 +328,20 @@ class ClusterUpgradeStateManager:
         return state
 
     def _build_node_upgrade_state(
-        self, pod: JsonObj, ds: Optional[JsonObj]
+        self, pod: JsonObj, ds: Optional[JsonObj], nodes_by_name=None
     ) -> NodeUpgradeState:
         """Reference: buildNodeUpgradeState (:354-378) — node read through
-        the informer cache."""
+        the informer cache (or the cycle's prefetched Node snapshot)."""
         node_name = (pod.get("spec") or {}).get("nodeName", "")
-        try:
-            node = self._provider.get_node(node_name)
-        except NotFoundError as err:
-            raise UpgradeStateError(
-                f"node {node_name} for driver pod "
-                f"{pod['metadata']['name']} not found"
-            ) from err
+        node = (nodes_by_name or {}).get(node_name)
+        if node is None:
+            try:
+                node = self._provider.get_node(node_name)
+            except NotFoundError as err:
+                raise UpgradeStateError(
+                    f"node {node_name} for driver pod "
+                    f"{pod['metadata']['name']} not found"
+                ) from err
         node_state = NodeUpgradeState(node=node, driver_pod=pod, driver_daemonset=ds)
         if self._requestor is not None and hasattr(
             self._requestor, "attach_node_maintenance"
