@@ -325,11 +325,16 @@ def main() -> None:
     # ceiling (store indexes, slot math, cascade) at scale.
     def scale_probe(slices: int, hosts: int) -> tuple:
         nodes = slices * hosts
-        wall = run_rollout(
-            tuned_policy,
-            cascade=True,
-            fleet_builder=lambda c: build_big_fleet(c, slices, hosts),
-            lag_seconds=0.0,
+        # best-of-2: a single big-fleet run carries seconds of GC/alloc
+        # noise (observed ±15% at 4,096 nodes)
+        wall = best_of(
+            2,
+            lambda: run_rollout(
+                tuned_policy,
+                cascade=True,
+                fleet_builder=lambda c: build_big_fleet(c, slices, hosts),
+                lag_seconds=0.0,
+            ),
         )
         return nodes / (wall / 60.0), wall
 
