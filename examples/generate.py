@@ -37,16 +37,29 @@ def main() -> int:
     parser.add_argument("--prompt-len", type=int, default=8)
     parser.add_argument("--new-tokens", type=int, default=16)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--temperature", type=float, default=0.0,
+        help="0 = greedy; >0 samples the softmax at this temperature",
+    )
+    parser.add_argument(
+        "--top-k", type=int, default=0,
+        help="restrict sampling to the k most-probable tokens (0 = all)",
+    )
+    parser.add_argument(
+        "--int8", action="store_true",
+        help="serve weight-only int8 quantized weights",
+    )
     args = parser.parse_args()
 
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from k8s_operator_libs_tpu.tpu.quantize import quantize_params_int8
     from k8s_operator_libs_tpu.tpu.workload import (
         ModelConfig,
         create_train_state,
-        greedy_generate,
+        generate,
         make_batch,
         make_train_step,
         restore_checkpoint,
@@ -82,10 +95,15 @@ def main() -> int:
         rng.integers(0, config.vocab_size, (args.batch, args.prompt_len)),
         jnp.int32,
     )
-    out = greedy_generate(config, params, prompt, args.new_tokens)
+    serve_params = quantize_params_int8(params) if args.int8 else params
+    run = lambda: generate(  # noqa: E731
+        config, serve_params, prompt, args.new_tokens,
+        temperature=args.temperature, top_k=args.top_k, seed=args.seed,
+    )
+    out = run()
     jax.block_until_ready(out)
     t0 = time.perf_counter()
-    out = greedy_generate(config, params, prompt, args.new_tokens)
+    out = run()
     jax.block_until_ready(out)
     elapsed = time.perf_counter() - t0
     for row in np.asarray(out):
@@ -95,7 +113,9 @@ def main() -> int:
     rate = args.batch * args.new_tokens / max(elapsed, 1e-9)
     print(
         f"{args.new_tokens} tokens x {args.batch} sequences in "
-        f"{elapsed*1e3:.1f} ms ({rate:.0f} tokens/s, KV-cache decode)"
+        f"{elapsed*1e3:.1f} ms ({rate:.0f} tokens/s, KV-cache decode"
+        f"{', int8' if args.int8 else ''}"
+        f"{f', T={args.temperature} top_k={args.top_k}' if args.temperature > 0 else ', greedy'})"
     )
     return 0
 
