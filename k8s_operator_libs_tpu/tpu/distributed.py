@@ -1,0 +1,151 @@
+"""Multi-host initialization — the distributed communication backend.
+
+The reference's only "distribution" is the kube-apiserver as shared
+store (SURVEY §2); the TPU-native framework this repo builds around it
+must ALSO scale its compute across hosts.  The backend is jax's
+distributed runtime: one coordinator, N processes, XLA collectives
+(psum / all_gather / ppermute / reduce_scatter) compiled over the
+global mesh — riding ICI inside a slice and DCN between slices, with
+zero NCCL/MPI-style application plumbing.  This module is the glue an
+operator-managed fleet needs:
+
+* :func:`initialize_from_env` — process identity from the environment
+  the deployment story provides (explicit vars, or a StatefulSet-style
+  hostname ordinal), then ``jax.distributed.initialize``;
+* :func:`global_mesh` — a named Mesh over EVERY process's devices
+  (the multi-host analog of ``workload.make_mesh``);
+* :func:`sync_global_devices` — a named cross-process barrier (the
+  multihost_utils pattern): proves the collective path live and fences
+  host-side side effects (checkpoint rotation, data-epoch swaps).
+
+Proven end-to-end by a REAL two-process test
+(tests/test_multiprocess_distributed.py): two OS processes, each with
+its own CPU devices, form one mesh, run the demo LM's sharded train
+step data-parallel across processes, and must agree bit-for-bit on the
+all-reduced loss.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _ordinal_from_hostname(hostname: str) -> Optional[int]:
+    """StatefulSet pods are named <name>-<ordinal>; the ordinal is the
+    natural process id for a fleet launched as a StatefulSet."""
+    m = re.search(r"-(\d+)$", hostname)
+    return int(m.group(1)) if m else None
+
+
+def resolve_identity(env: Optional[dict] = None) -> Tuple[str, int, int]:
+    """(coordinator_address, num_processes, process_id) from the
+    environment:
+
+    * ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+      ``JAX_PROCESS_ID`` — explicit (the operator/deployment sets
+      them);
+    * process id falls back to the StatefulSet hostname ordinal
+      (<pod>-<n>) when unset.
+
+    Raises ValueError when the coordinator or world size is missing —
+    single-process callers should simply not call initialize.
+    """
+    env = dict(os.environ if env is None else env)
+    addr = env.get("JAX_COORDINATOR_ADDRESS", "")
+    if not addr:
+        raise ValueError(
+            "JAX_COORDINATOR_ADDRESS not set (multi-host initialization "
+            "needs a coordinator; single-process runs skip initialize)"
+        )
+    try:
+        num = int(env.get("JAX_NUM_PROCESSES", ""))
+    except ValueError as err:
+        raise ValueError("JAX_NUM_PROCESSES must be an integer") from err
+    pid_raw = env.get("JAX_PROCESS_ID", "")
+    if pid_raw:
+        pid = int(pid_raw)
+    else:
+        hostname = env.get("HOSTNAME", "") or socket.gethostname()
+        ordinal = _ordinal_from_hostname(hostname)
+        if ordinal is None:
+            raise ValueError(
+                "JAX_PROCESS_ID unset and hostname carries no "
+                f"StatefulSet ordinal: {hostname!r}"
+            )
+        pid = ordinal
+    if not 0 <= pid < num:
+        raise ValueError(f"process id {pid} outside world size {num}")
+    return addr, num, pid
+
+
+def initialize_from_env(env: Optional[dict] = None) -> Tuple[int, int]:
+    """``jax.distributed.initialize`` with :func:`resolve_identity`.
+    Returns (process_id, num_processes).  Idempotent per process (jax
+    raises on double-initialize; we surface that as-is — calling twice
+    is a deployment bug worth seeing)."""
+    import jax
+
+    addr, num, pid = resolve_identity(env)
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=num, process_id=pid
+    )
+    return pid, num
+
+
+def global_mesh(
+    dp: Optional[int] = None,
+    tp: int = 1,
+    sp: int = 1,
+    ep: int = 1,
+):
+    """A ``(data, seq, model, expert)`` Mesh over every process's
+    devices (``jax.devices()`` is GLOBAL after initialize).  Defaults
+    to all-data-parallel; axis sizes must divide the global device
+    count."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices())
+    n = devices.size
+    if dp is None:
+        dp = n // (tp * sp * ep)
+    if dp * tp * sp * ep != n:
+        raise ValueError(
+            f"dp*sp*tp*ep = {dp * sp * tp * ep} != global devices {n}"
+        )
+    return Mesh(
+        devices.reshape(dp, sp, tp, ep), ("data", "seq", "model", "expert")
+    )
+
+
+def sync_global_devices(name: str = "barrier") -> None:
+    """Cross-process barrier: every process must reach this point
+    before any continues — an all-reduce over one scalar per device,
+    jitted over the global mesh.  *name* only aids debugging (it is
+    baked into the traced function's label)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = global_mesh()
+    ones = jax.device_put(
+        np.ones((mesh.devices.size,), np.float32),
+        NamedSharding(mesh, P(("data", "seq", "model", "expert"))),
+    )
+
+    def _barrier(x):
+        return x.sum()
+
+    total = jax.jit(
+        _barrier, out_shardings=NamedSharding(mesh, P())
+    )(ones)
+    if int(total) != mesh.devices.size:
+        raise RuntimeError(
+            f"{name}: barrier sum {int(total)} != world device count "
+            f"{mesh.devices.size}"
+        )

@@ -1,0 +1,138 @@
+"""REAL multi-host distributed backend e2e: two OS processes, each
+with its own CPU devices, joined by jax's distributed runtime (gRPC
+coordinator — the DCN analog), running ONE sharded train step
+data-parallel across the process boundary.
+
+This is the proof the virtual single-process mesh cannot give: the
+loss is all-reduced across processes, so identical printed losses mean
+the collectives genuinely crossed the wire.  (SURVEY §5: the
+reference's only distribution is the apiserver; the TPU-native
+framework must also scale compute multi-host.)"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = Path(__file__).resolve().parent / "distributed_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_data_parallel_train_step_agrees():
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            {
+                "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                "JAX_NUM_PROCESSES": "2",
+                "JAX_PROCESS_ID": str(pid),
+                # each process gets its own 4 virtual CPU devices —
+                # the global mesh is 8 across the two processes
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                "PALLAS_AXON_POOL_IPS": "",
+            }
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(WORKER)],
+                env=env,
+                cwd=str(REPO),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    results = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        line = [ln for ln in out.splitlines() if ln.startswith("{")][-1]
+        results.append(json.loads(line))
+
+    by_pid = {r["process_id"]: r for r in results}
+    assert set(by_pid) == {0, 1}
+    for r in results:
+        assert r["num_processes"] == 2
+        assert r["global_devices"] == 8  # 2 processes x 4 local
+        assert r["local_devices"] == 4
+        assert all(x > 0 for x in r["losses"])
+    # the collective proof: the all-reduced loss sequence is identical
+    # across processes
+    assert by_pid[0]["losses"] == by_pid[1]["losses"], by_pid
+
+
+class TestResolveIdentity:
+    """Process identity from the deployment environment (explicit vars
+    or the StatefulSet hostname ordinal)."""
+
+    def test_explicit_env(self):
+        from k8s_operator_libs_tpu.tpu.distributed import resolve_identity
+
+        addr, num, pid = resolve_identity(
+            {
+                "JAX_COORDINATOR_ADDRESS": "10.0.0.1:1234",
+                "JAX_NUM_PROCESSES": "4",
+                "JAX_PROCESS_ID": "2",
+            }
+        )
+        assert (addr, num, pid) == ("10.0.0.1:1234", 4, 2)
+
+    def test_statefulset_ordinal_fallback(self):
+        from k8s_operator_libs_tpu.tpu.distributed import resolve_identity
+
+        addr, num, pid = resolve_identity(
+            {
+                "JAX_COORDINATOR_ADDRESS": "head:1234",
+                "JAX_NUM_PROCESSES": "8",
+                "HOSTNAME": "tpu-worker-5",
+            }
+        )
+        assert pid == 5
+
+    def test_missing_coordinator_rejected(self):
+        from k8s_operator_libs_tpu.tpu.distributed import resolve_identity
+
+        with pytest.raises(ValueError):
+            resolve_identity({"JAX_NUM_PROCESSES": "2"})
+
+    def test_out_of_range_pid_rejected(self):
+        from k8s_operator_libs_tpu.tpu.distributed import resolve_identity
+
+        with pytest.raises(ValueError):
+            resolve_identity(
+                {
+                    "JAX_COORDINATOR_ADDRESS": "h:1",
+                    "JAX_NUM_PROCESSES": "2",
+                    "JAX_PROCESS_ID": "2",
+                }
+            )
+
+    def test_hostname_without_ordinal_rejected(self):
+        from k8s_operator_libs_tpu.tpu.distributed import resolve_identity
+
+        with pytest.raises(ValueError):
+            resolve_identity(
+                {
+                    "JAX_COORDINATOR_ADDRESS": "h:1",
+                    "JAX_NUM_PROCESSES": "2",
+                    "HOSTNAME": "nodename",
+                }
+            )
