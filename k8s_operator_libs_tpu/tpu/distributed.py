@@ -123,6 +123,35 @@ def global_mesh(
     )
 
 
+def host_allreduce_max(value: float) -> float:
+    """All-reduce a host-side scalar across every process (max-combine)
+    through an XLA collective over the global mesh — the pattern a
+    drain signal needs: ONE process watches the node annotation and
+    contributes 1.0, everyone else 0.0, and every process must agree,
+    at the same step, that a checkpoint-stop was requested (host-side
+    control flow may not diverge across processes or their next
+    collective deadlocks).  Uses the same jit-over-global-mesh
+    machinery as :func:`sync_global_devices` — one element per device,
+    this process's elements carrying *value*."""
+    import jax
+    import jax.numpy as jnp  # noqa: F401 — dtype anchors
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = global_mesh()
+    n = mesh.devices.size
+    sharding = NamedSharding(
+        mesh, P(("data", "seq", "model", "expert"))
+    )
+    arr = jax.make_array_from_callback(
+        (n,), sharding,
+        lambda idx: np.full((1,), value, np.float32),
+    )
+    out = jax.jit(
+        lambda x: x.max(), out_shardings=NamedSharding(mesh, P())
+    )(arr)
+    return float(out)
+
+
 def sync_global_devices(name: str = "barrier") -> None:
     """Cross-process barrier: every process must reach this point
     before any continues — an all-reduce over one scalar per device,

@@ -1,0 +1,116 @@
+"""Worker for the multi-process checkpoint-on-drain e2e: a 2-process
+data-parallel training job whose drain protocol is the REAL multi-host
+pattern — one process watches the node annotation over HTTP, the stop
+decision is broadcast through a collective so every process stops at
+the SAME step (divergent host-side control flow would deadlock the
+next collective), the (replicated) state is checkpointed once, the
+drain is acknowledged, and everyone exits through a barrier."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    from k8s_operator_libs_tpu.tpu.distributed import (
+        global_mesh,
+        host_allreduce_max,
+        initialize_from_env,
+        sync_global_devices,
+    )
+
+    pid, num = initialize_from_env()
+
+    import jax
+    import numpy as np
+
+    from k8s_operator_libs_tpu.cluster import KubeApiClient, KubeConfig
+    from k8s_operator_libs_tpu.tpu import workload as wl
+    from k8s_operator_libs_tpu.tpu.drain_handshake import DrainSignalWatcher
+
+    node_name = os.environ["DRAIN_NODE_NAME"]
+    ckpt_dir = os.environ["DRAIN_CKPT_DIR"]
+    max_steps = int(os.environ.get("DRAIN_MAX_STEPS", "500"))
+
+    watcher = None
+    if pid == 0:
+        client = KubeApiClient(
+            KubeConfig(server=os.environ["FACADE_URL"]), timeout=10.0
+        )
+        watcher = DrainSignalWatcher(client, node_name)
+
+    def trace(msg):
+        print(f"[pid {pid}] {msg}", file=sys.stderr, flush=True)
+
+    mesh = global_mesh()
+    trace("mesh ready")
+    cfg = wl.ModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=16,
+    )
+    with mesh:
+        model, params, tx, opt = wl.create_train_state(cfg, mesh)
+        step_fn = wl.make_train_step(model, tx, mesh)
+        trace("state created")
+        sync_global_devices("trained-state-ready")
+        trace("post-init barrier done")
+        step = 0
+        loss = None
+        while step < max_steps:
+            batch = wl.make_batch(
+                cfg, batch_size=mesh.devices.size, seed=step
+            )
+            params, opt, loss = step_fn(params, opt, batch)
+            step += 1
+            requested = (
+                1.0
+                if (watcher is not None and watcher.checkpoint_requested())
+                else 0.0
+            )
+            # EVERY process must agree on the stop step — the watcher's
+            # host-side observation crosses the job via the collective
+            flag = host_allreduce_max(requested)
+            if step % 10 == 0:
+                trace(f"step {step} flag {flag}")
+            if flag > 0.0:
+                break
+        drained = step < max_steps
+        # params are replicated over the all-data mesh: every process
+        # holds a full copy, so the coordinator checkpoints alone
+        trace(f"loop done at step {step} drained={drained}")
+        if drained:
+            # orbax synchronizes across processes internally when
+            # jax.process_count() > 1 — a save on ONE process would
+            # misalign the job's collective order (observed as a gloo
+            # payload mismatch).  EVERY process saves; non-coordinators
+            # write a throwaway shadow directory (state is replicated,
+            # so the real checkpoint is complete either way).
+            target = ckpt_dir if pid == 0 else f"{ckpt_dir}-shadow-{pid}"
+            wl.save_checkpoint(
+                target,
+                step,
+                jax.device_get(params),
+                jax.device_get(opt),
+            )
+            trace("checkpoint saved")
+            if pid == 0:
+                watcher.acknowledge()
+        sync_global_devices("post-drain")
+    print(
+        json.dumps(
+            {
+                "process_id": pid,
+                "stopped_at_step": step,
+                "drained": drained,
+                "final_loss": round(float(loss), 6),
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

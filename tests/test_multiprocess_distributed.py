@@ -136,3 +136,107 @@ class TestResolveIdentity:
                     "HOSTNAME": "nodename",
                 }
             )
+
+
+def test_two_process_checkpoint_on_drain(tmp_path):
+    """Capstone: the operator-side drain handshake against a REAL
+    two-process JAX job.  The orchestrator requests a pre-drain
+    checkpoint via the node annotation; process 0 observes it over
+    HTTP, the stop decision crosses the job through a collective
+    broadcast (both processes stop at the SAME step), the replicated
+    state is checkpointed once, the drain is acknowledged, and both
+    workers exit through a barrier."""
+    import time
+
+    from k8s_operator_libs_tpu.cluster import (
+        ApiServerFacade,
+        InMemoryCluster,
+    )
+    from k8s_operator_libs_tpu.cluster.objects import make_node
+    from k8s_operator_libs_tpu.upgrade import consts, util
+
+    store = InMemoryCluster()
+    store.create(make_node("tpu-host-0"))
+    facade = ApiServerFacade(store).start()
+    port = _free_port()
+    ckpt_dir = str(tmp_path / "ckpt")
+    procs = []
+    try:
+        for pid in range(2):
+            env = dict(os.environ)
+            env.update(
+                {
+                    "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                    "JAX_NUM_PROCESSES": "2",
+                    "JAX_PROCESS_ID": str(pid),
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                    "PALLAS_AXON_POOL_IPS": "",
+                    "FACADE_URL": facade.url,
+                    "DRAIN_NODE_NAME": "tpu-host-0",
+                    "DRAIN_CKPT_DIR": ckpt_dir,
+                }
+            )
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        str(WORKER.parent / "distributed_drain_worker.py"),
+                    ],
+                    env=env,
+                    cwd=str(REPO),
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+            )
+        # let the job train a little, then request the checkpoint-drain
+        time.sleep(12)
+        key = util.get_pre_drain_checkpoint_annotation_key()
+        store.patch(
+            "Node",
+            "tpu-host-0",
+            {
+                "metadata": {
+                    "annotations": {
+                        key: f"{consts.PRE_DRAIN_CHECKPOINT_REQUESTED}:e2e-1",
+                    }
+                }
+            },
+        )
+        results = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            line = [
+                ln for ln in out.splitlines() if ln.startswith("{")
+            ][-1]
+            results.append(json.loads(line))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        facade.stop()
+
+    by_pid = {r["process_id"]: r for r in results}
+    assert all(r["drained"] for r in results), by_pid
+    # the collective broadcast: both processes stopped at the SAME step
+    assert (
+        by_pid[0]["stopped_at_step"] == by_pid[1]["stopped_at_step"]
+    ), by_pid
+    assert by_pid[0]["final_loss"] == by_pid[1]["final_loss"], by_pid
+    # the drain was acknowledged on the node...
+    node = store.get("Node", "tpu-host-0")
+    key = util.get_pre_drain_checkpoint_annotation_key()
+    ack = (node["metadata"].get("annotations") or {}).get(key, "")
+    assert ack.startswith(consts.PRE_DRAIN_CHECKPOINT_DONE), ack
+    # ...and the checkpoint actually landed at the agreed step
+    from k8s_operator_libs_tpu.tpu.workload import restore_checkpoint
+
+    restored = restore_checkpoint(ckpt_dir, by_pid[0]["stopped_at_step"])
+    assert restored["step"] == by_pid[0]["stopped_at_step"]
