@@ -123,6 +123,31 @@ def global_mesh(
     )
 
 
+#: (reduction, device-ids) -> (mesh, sharding, jitted fn) — these
+#: collectives sit on per-step hot paths (the drain poll), so the mesh
+#: and the jitted reduction are built once per process, not per call
+_collective_cache: dict = {}
+
+
+def _cached_collective(kind: str):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    key = (kind, tuple(id(d) for d in jax.devices()))
+    hit = _collective_cache.get(key)
+    if hit is not None:
+        return hit
+    mesh = global_mesh()
+    sharding = NamedSharding(mesh, P(("data", "seq", "model", "expert")))
+    reduce_fn = (lambda x: x.max()) if kind == "max" else (lambda x: x.sum())
+    fn = jax.jit(reduce_fn, out_shardings=NamedSharding(mesh, P()))
+    entry = (mesh, sharding, fn)
+    if len(_collective_cache) >= 8:
+        _collective_cache.clear()
+    _collective_cache[key] = entry
+    return entry
+
+
 def host_allreduce_max(value: float) -> float:
     """All-reduce a host-side scalar across every process (max-combine)
     through an XLA collective over the global mesh — the pattern a
@@ -130,49 +155,32 @@ def host_allreduce_max(value: float) -> float:
     contributes 1.0, everyone else 0.0, and every process must agree,
     at the same step, that a checkpoint-stop was requested (host-side
     control flow may not diverge across processes or their next
-    collective deadlocks).  Uses the same jit-over-global-mesh
-    machinery as :func:`sync_global_devices` — one element per device,
-    this process's elements carrying *value*."""
+    collective deadlocks).  One element per device, this process's
+    elements carrying *value*; the jitted reduction is cached (this
+    runs per training step)."""
     import jax
-    import jax.numpy as jnp  # noqa: F401 — dtype anchors
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    mesh = global_mesh()
-    n = mesh.devices.size
-    sharding = NamedSharding(
-        mesh, P(("data", "seq", "model", "expert"))
-    )
+    mesh, sharding, fn = _cached_collective("max")
     arr = jax.make_array_from_callback(
-        (n,), sharding,
+        (mesh.devices.size,), sharding,
         lambda idx: np.full((1,), value, np.float32),
     )
-    out = jax.jit(
-        lambda x: x.max(), out_shardings=NamedSharding(mesh, P())
-    )(arr)
-    return float(out)
+    return float(fn(arr))
 
 
 def sync_global_devices(name: str = "barrier") -> None:
     """Cross-process barrier: every process must reach this point
     before any continues — an all-reduce over one scalar per device,
-    jitted over the global mesh.  *name* only aids debugging (it is
-    baked into the traced function's label)."""
+    jitted once per process over the global mesh.  *name* only aids
+    debugging of a failed barrier."""
     import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    mesh = global_mesh()
-    ones = jax.device_put(
-        np.ones((mesh.devices.size,), np.float32),
-        NamedSharding(mesh, P(("data", "seq", "model", "expert"))),
+    mesh, sharding, fn = _cached_collective("sum")
+    ones = jax.make_array_from_callback(
+        (mesh.devices.size,), sharding,
+        lambda idx: np.ones((1,), np.float32),
     )
-
-    def _barrier(x):
-        return x.sum()
-
-    total = jax.jit(
-        _barrier, out_shardings=NamedSharding(mesh, P())
-    )(ones)
+    total = fn(ones)
     if int(total) != mesh.devices.size:
         raise RuntimeError(
             f"{name}: barrier sum {int(total)} != world device count "

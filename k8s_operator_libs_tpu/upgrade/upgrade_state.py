@@ -298,13 +298,14 @@ class ClusterUpgradeStateManager:
 
         state_label = util.get_upgrade_state_label_key()
         # one snapshot Node list instead of a per-pod cache get: at 4k
-        # nodes that is one store-lock acquisition per cycle, not 4k
-        # (same source the per-node read would hit — the reader when
-        # cache-backed, else the cluster the lag-0 cache passes through
-        # to — so the snapshot semantics are unchanged)
+        # nodes that is one store-lock acquisition per cycle, not 4k.
+        # Listed from the CACHE — the exact source provider.get_node
+        # reads — so a lagged cache still governs the node view even
+        # when reads_from_cache is off (the reference's 'node read
+        # through the informer cache' contract).
         nodes_by_name = {
             (n.get("metadata") or {}).get("name", ""): n
-            for n in self._reader.list("Node")
+            for n in self._cache.list("Node")
         }
         for pod in filtered:
             owner_ds = None

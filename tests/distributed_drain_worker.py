@@ -32,7 +32,11 @@ def main() -> int:
 
     node_name = os.environ["DRAIN_NODE_NAME"]
     ckpt_dir = os.environ["DRAIN_CKPT_DIR"]
-    max_steps = int(os.environ.get("DRAIN_MAX_STEPS", "500"))
+    # a RUNAWAY bound, not the expected stop: the drain request is the
+    # real exit; steps are milliseconds once compiled, so this must be
+    # large enough that the orchestrator's request always lands first
+    max_steps = int(os.environ.get("DRAIN_MAX_STEPS", "1000000"))
+    deadline = float(os.environ.get("DRAIN_MAX_SECONDS", "180"))
 
     watcher = None
     if pid == 0:
@@ -56,9 +60,13 @@ def main() -> int:
         trace("state created")
         sync_global_devices("trained-state-ready")
         trace("post-init barrier done")
+        import time as _time
+
+        t0 = _time.monotonic()
         step = 0
         loss = None
-        while step < max_steps:
+        drained = False
+        while step < max_steps and _time.monotonic() - t0 < deadline:
             batch = wl.make_batch(
                 cfg, batch_size=mesh.devices.size, seed=step
             )
@@ -75,8 +83,8 @@ def main() -> int:
             if step % 10 == 0:
                 trace(f"step {step} flag {flag}")
             if flag > 0.0:
+                drained = True
                 break
-        drained = step < max_steps
         # params are replicated over the all-data mesh: every process
         # holds a full copy, so the coordinator checkpoints alone
         trace(f"loop done at step {step} drained={drained}")
@@ -95,9 +103,12 @@ def main() -> int:
                 jax.device_get(opt),
             )
             trace("checkpoint saved")
-            if pid == 0:
-                watcher.acknowledge()
         sync_global_devices("post-drain")
+        # ack AFTER the barrier: the operator reacts to the ack by
+        # evicting pods, and a peer still between its save and the
+        # barrier would leave this process hung if eviction began now
+        if drained and pid == 0:
+            watcher.acknowledge()
     print(
         json.dumps(
             {
