@@ -1,10 +1,9 @@
 """Worker for the multi-process checkpoint-on-drain e2e: a 2-process
-data-parallel training job whose drain protocol is the REAL multi-host
-pattern — one process watches the node annotation over HTTP, the stop
-decision is broadcast through a collective so every process stops at
-the SAME step (divergent host-side control flow would deadlock the
-next collective), the (replicated) state is checkpointed once, the
-drain is acknowledged, and everyone exits through a barrier."""
+data-parallel training job driven by the library's
+MultihostDrainLoop (k8s_operator_libs_tpu/tpu/multihost_trainer.py) —
+one process watches the node annotation over HTTP, the stop decision
+crosses the job via a collective, every process saves (shadow pattern),
+the ack follows the exit barrier."""
 
 import json
 import os
@@ -16,27 +15,28 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def main() -> int:
     from k8s_operator_libs_tpu.tpu.distributed import (
         global_mesh,
-        host_allreduce_max,
         initialize_from_env,
-        sync_global_devices,
     )
 
     pid, num = initialize_from_env()
 
     import jax
-    import numpy as np
 
     from k8s_operator_libs_tpu.cluster import KubeApiClient, KubeConfig
     from k8s_operator_libs_tpu.tpu import workload as wl
     from k8s_operator_libs_tpu.tpu.drain_handshake import DrainSignalWatcher
+    from k8s_operator_libs_tpu.tpu.multihost_trainer import (
+        MultihostDrainLoop,
+        shadow_dir,
+    )
 
     node_name = os.environ["DRAIN_NODE_NAME"]
     ckpt_dir = os.environ["DRAIN_CKPT_DIR"]
-    # a RUNAWAY bound, not the expected stop: the drain request is the
-    # real exit; steps are milliseconds once compiled, so this must be
-    # large enough that the orchestrator's request always lands first
     max_steps = int(os.environ.get("DRAIN_MAX_STEPS", "1000000"))
-    deadline = float(os.environ.get("DRAIN_MAX_SECONDS", "180"))
+    max_seconds = float(os.environ.get("DRAIN_MAX_SECONDS", "180"))
+
+    def trace(msg):
+        print(f"[pid {pid}] {msg}", file=sys.stderr, flush=True)
 
     watcher = None
     if pid == 0:
@@ -44,9 +44,6 @@ def main() -> int:
             KubeConfig(server=os.environ["FACADE_URL"]), timeout=10.0
         )
         watcher = DrainSignalWatcher(client, node_name)
-
-    def trace(msg):
-        print(f"[pid {pid}] {msg}", file=sys.stderr, flush=True)
 
     mesh = global_mesh()
     trace("mesh ready")
@@ -58,64 +55,45 @@ def main() -> int:
         model, params, tx, opt = wl.create_train_state(cfg, mesh)
         step_fn = wl.make_train_step(model, tx, mesh)
         trace("state created")
-        sync_global_devices("trained-state-ready")
-        trace("post-init barrier done")
-        import time as _time
 
-        t0 = _time.monotonic()
-        step = 0
-        loss = None
-        drained = False
-        while step < max_steps and _time.monotonic() - t0 < deadline:
+        losses = []
+
+        def do_step(state, step):
+            params, opt = state
             batch = wl.make_batch(
                 cfg, batch_size=mesh.devices.size, seed=step
             )
             params, opt, loss = step_fn(params, opt, batch)
-            step += 1
-            requested = (
-                1.0
-                if (watcher is not None and watcher.checkpoint_requested())
-                else 0.0
-            )
-            # EVERY process must agree on the stop step — the watcher's
-            # host-side observation crosses the job via the collective
-            flag = host_allreduce_max(requested)
-            if step % 10 == 0:
-                trace(f"step {step} flag {flag}")
-            if flag > 0.0:
-                drained = True
-                break
-        # params are replicated over the all-data mesh: every process
-        # holds a full copy, so the coordinator checkpoints alone
-        trace(f"loop done at step {step} drained={drained}")
-        if drained:
-            # orbax synchronizes across processes internally when
-            # jax.process_count() > 1 — a save on ONE process would
-            # misalign the job's collective order (observed as a gloo
-            # payload mismatch).  EVERY process saves; non-coordinators
-            # write a throwaway shadow directory (state is replicated,
-            # so the real checkpoint is complete either way).
-            target = ckpt_dir if pid == 0 else f"{ckpt_dir}-shadow-{pid}"
+            losses.append(loss)
+            return (params, opt), loss
+
+        def do_save(state, step):
+            params, opt = state
             wl.save_checkpoint(
-                target,
+                shadow_dir(ckpt_dir, pid),
                 step,
                 jax.device_get(params),
                 jax.device_get(opt),
             )
             trace("checkpoint saved")
-        sync_global_devices("post-drain")
-        # ack AFTER the barrier: the operator reacts to the ack by
-        # evicting pods, and a peer still between its save and the
-        # barrier would leave this process hung if eviction began now
-        if drained and pid == 0:
-            watcher.acknowledge()
+
+        loop = MultihostDrainLoop(
+            do_step,
+            do_save,
+            watcher=watcher,
+            max_steps=max_steps,
+            max_seconds=max_seconds,
+        )
+        (params, opt), step, drained = loop.run((params, opt))
+        trace(f"loop done at step {step} drained={drained}")
+        final_loss = float(losses[-1]) if losses else 0.0
     print(
         json.dumps(
             {
                 "process_id": pid,
                 "stopped_at_step": step,
                 "drained": drained,
-                "final_loss": round(float(loss), 6),
+                "final_loss": round(final_loss, 6),
             }
         ),
         flush=True,
