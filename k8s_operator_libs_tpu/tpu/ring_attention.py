@@ -229,6 +229,139 @@ def ring_flash_attention(
     return o.astype(q.dtype)
 
 
+def to_zigzag(x, n: int):
+    """Permute the sequence axis (axis 1) from natural order into the
+    zigzag layout: the global sequence is cut into ``2n`` chunks and
+    device i holds chunks ``(i, 2n-1-i)`` — so under a causal mask
+    every device carries one early (cheap) and one late (expensive)
+    chunk and the ring's causal work balances, instead of device 0
+    masking almost everything and device n-1 nothing."""
+    b, s = x.shape[0], x.shape[1]
+    if s % (2 * n):
+        raise ValueError(f"seq {s} not divisible by 2n = {2 * n}")
+    chunks = x.reshape((b, 2 * n, s // (2 * n)) + x.shape[2:])
+    order = []
+    for i in range(n):
+        order += [i, 2 * n - 1 - i]
+    return chunks[:, jnp.asarray(order)].reshape(x.shape)
+
+
+def from_zigzag(x, n: int):
+    """Inverse of :func:`to_zigzag`."""
+    b, s = x.shape[0], x.shape[1]
+    chunks = x.reshape((b, 2 * n, s // (2 * n)) + x.shape[2:])
+    order = []
+    for i in range(n):
+        order += [i, 2 * n - 1 - i]
+    inverse = [0] * (2 * n)
+    for pos, c in enumerate(order):
+        inverse[c] = pos
+    return chunks[:, jnp.asarray(inverse)].reshape(x.shape)
+
+
+def zigzag_ring_flash_attention(
+    q, k, v, axis_name: str, block: int = 128
+):
+    """Causal ring-of-flash over the ZIGZAG layout — the balanced form
+    of :func:`ring_flash_attention`.
+
+    Contiguous chunks give the causal ring wildly uneven work (device 0
+    skips nearly every pair, device n-1 none).  Here each device holds
+    global chunks ``(my, 2n-1-my)`` (:func:`to_zigzag`), so every
+    device owns one early and one late chunk and each ring step does
+    the same work everywhere.  Per step the 2x2 sub-chunk pairs are
+    classified by their GLOBAL chunk ids — q-chunk > k-chunk runs the
+    flash kernel unmasked, equal runs it causal, less skips — and each
+    local half keeps its own (o, lse) accumulator, merged in the
+    logsumexp frame exactly like the contiguous ring.  Differentiable
+    end-to-end through flash_attention_lse.
+
+    Inputs are the PER-DEVICE zigzag shards (inside shard_map); use
+    the ``layout="zigzag"`` mode of :func:`ring_attention_sharded` for
+    the natural-layout seam."""
+    from .flash_attention import flash_attention_lse
+
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    if s_loc % 2:
+        raise ValueError("zigzag needs an even local sequence")
+    s_half = s_loc // 2
+    blk = min(block, s_half)
+    if s_half % blk:
+        raise ValueError(
+            f"zigzag_ring_flash_attention needs block ({blk}) to divide "
+            f"the half-chunk ({s_half})"
+        )
+    interpret = jax.devices()[0].platform != "tpu"
+
+    q_halves = (q[:, :s_half], q[:, s_half:])
+    my_ids = (my, 2 * n - 1 - my)
+
+    def merge(o, lse, o_p, lse_p):
+        lse_new = jnp.logaddexp(lse, lse_p)  # [b*h, s_half]
+        w_old = jnp.exp(lse - lse_new).reshape(b, h, s_half)
+        w_new = jnp.exp(lse_p - lse_new).reshape(b, h, s_half)
+        o_new = (
+            o * w_old.transpose(0, 2, 1)[..., None]
+            + o_p.astype(jnp.float32) * w_new.transpose(0, 2, 1)[..., None]
+        )
+        return o_new, lse_new
+
+    def sub_pair(q_half, qc_id, kc_id, o_h, lse_h, k_h, v_h):
+        def full(ops):
+            o_h, lse_h, k_h, v_h = ops
+            o_p, lse_p = flash_attention_lse(
+                q_half, k_h, v_h, False, blk, blk, interpret
+            )
+            return merge(o_h, lse_h, o_p, lse_p)
+
+        def diag(ops):
+            o_h, lse_h, k_h, v_h = ops
+            o_p, lse_p = flash_attention_lse(
+                q_half, k_h, v_h, True, blk, blk, interpret
+            )
+            return merge(o_h, lse_h, o_p, lse_p)
+
+        def skip(ops):
+            o_h, lse_h, _k, _v = ops
+            return o_h, lse_h
+
+        return jax.lax.cond(
+            qc_id > kc_id,
+            full,
+            lambda ops: jax.lax.cond(qc_id == kc_id, diag, skip, ops),
+            (o_h, lse_h, k_h, v_h),
+        )
+
+    def step(carry, i):
+        oa, lsea, ob, lseb, k_blk, v_blk = carry
+        src = (my - i) % n
+        k_ids = (src, 2 * n - 1 - src)
+        k_halves = (k_blk[:, :s_half], k_blk[:, s_half:])
+        v_halves = (v_blk[:, :s_half], v_blk[:, s_half:])
+        for ki in (0, 1):
+            oa, lsea = sub_pair(
+                q_halves[0], my_ids[0], k_ids[ki],
+                oa, lsea, k_halves[ki], v_halves[ki],
+            )
+            ob, lseb = sub_pair(
+                q_halves[1], my_ids[1], k_ids[ki],
+                ob, lseb, k_halves[ki], v_halves[ki],
+            )
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (oa, lsea, ob, lseb, k_blk, v_blk), None
+
+    o0 = jnp.zeros((b, s_half, h, d), jnp.float32)
+    lse0 = jnp.full((b * h, s_half), _NEG, jnp.float32)
+    (oa, _, ob, _, _, _), _ = jax.lax.scan(
+        step, (o0, lse0, o0, lse0, k, v), jnp.arange(n)
+    )
+    return jnp.concatenate([oa, ob], axis=1).astype(q.dtype)
+
+
 def ring_attention_sharded(
     q,
     k,
@@ -240,6 +373,7 @@ def ring_attention_sharded(
     causal: bool = True,
     use_flash: bool = False,
     flash_block: int = 128,
+    layout: str = "contiguous",
 ):
     """`shard_map` wrapper: global [batch, seq, heads, head_dim] arrays
     sharded (batch over *batch_axis*, seq over *seq_axis*, and — when
@@ -255,7 +389,13 @@ def ring_attention_sharded(
     *use_flash* swaps the per-pair einsum engine for the Pallas flash
     kernel (:func:`ring_flash_attention`) — O(block) VMEM per chip
     instead of a [seq_local, seq_local] score matrix; *flash_block*
-    must divide the local sequence."""
+    must divide the local sequence.
+
+    *layout="zigzag"* (flash + causal only) runs the BALANCED causal
+    ring (:func:`zigzag_ring_flash_attention`): inputs/outputs stay in
+    natural sequence order — the wrapper permutes into the zigzag
+    layout and back (a one-time all-to-all; a production training
+    setup keeps its data zigzag-resident instead)."""
     try:
         from jax import shard_map  # jax >= 0.8
         kw = {"check_vma": False}
@@ -264,6 +404,26 @@ def ring_attention_sharded(
         kw = {"check_rep": False}
 
     spec = P(batch_axis, seq_axis, heads_axis, None)
+    if layout == "zigzag":
+        if not (use_flash and causal):
+            raise ValueError(
+                "layout='zigzag' requires use_flash=True and causal=True"
+            )
+        n = mesh.shape[seq_axis]
+        fn = functools.partial(
+            zigzag_ring_flash_attention,
+            axis_name=seq_axis,
+            block=flash_block,
+        )
+        qz, kz, vz = (to_zigzag(x, n) for x in (q, k, v))
+        out = shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            **kw,
+        )(qz, kz, vz)
+        return from_zigzag(out, n)
     if use_flash:
         fn = functools.partial(
             ring_flash_attention,

@@ -1011,3 +1011,114 @@ class TestRingFlashAttention:
                 _p, _o, loss = step(params, opt, batch)
             losses[name] = float(loss)
         assert abs(losses["einsum"] - losses["flash"]) < 1e-4, losses
+
+
+class TestZigzagRingFlash:
+    """Balanced causal ring (zigzag layout): device i holds global
+    chunks (i, 2n-1-i), so each ring step does equal work on every
+    device; 2x2 sub-chunk pairs classified by GLOBAL chunk ids.  Must
+    be exact vs dense through the natural-layout seam (the wrapper
+    permutes in/out)."""
+
+    def test_permutation_round_trip(self):
+        jax, jnp, np, *_ = TestRingAttention._jax()
+        from k8s_operator_libs_tpu.tpu.ring_attention import (
+            from_zigzag,
+            to_zigzag,
+        )
+
+        x = jnp.arange(2 * 48 * 2 * 3, dtype=jnp.float32).reshape(
+            2, 48, 2, 3
+        )
+        for n in (2, 4):
+            z = to_zigzag(x, n)
+            assert not (np.asarray(z) == np.asarray(x)).all()
+            assert (np.asarray(from_zigzag(z, n)) == np.asarray(x)).all()
+
+    def test_exact_vs_dense_fwd_and_grad(self):
+        jax, jnp, np, *_ = TestRingAttention._jax()
+        from k8s_operator_libs_tpu.tpu.ring_attention import (
+            dense_reference,
+            ring_attention_sharded,
+        )
+
+        mesh = TestRingAttention()._mesh()  # (data=2, seq=4)
+        rng = np.random.default_rng(5)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mk = lambda: jax.device_put(  # noqa: E731
+            jnp.asarray(rng.standard_normal((2, 256, 4, 16)), jnp.float32),
+            NamedSharding(mesh, P("data", "seq", None, None)),
+        )
+        q, k, v = mk(), mk(), mk()
+        zig = lambda a, b_, c: ring_attention_sharded(  # noqa: E731
+            a, b_, c, mesh, "seq", causal=True,
+            use_flash=True, flash_block=32, layout="zigzag",
+        )
+        out = zig(q, k, v)
+        ref = dense_reference(q, k, v, True)
+        assert float(jnp.abs(out - ref).max()) < 1e-4
+        gf = jax.grad(
+            lambda a, b_, c: (zig(a, b_, c).astype(jnp.float32) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gr = jax.grad(
+            lambda a, b_, c: (
+                dense_reference(a, b_, c, True).astype(jnp.float32) ** 2
+            ).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b_ in zip(gf, gr):
+            assert float(jnp.abs(a - b_).max()) < 1e-2
+
+    def test_zigzag_requires_flash_and_causal(self):
+        import pytest as _pytest
+
+        jax, jnp, np, *_ = TestRingAttention._jax()
+        from k8s_operator_libs_tpu.tpu.ring_attention import (
+            ring_attention_sharded,
+        )
+
+        mesh = TestRingAttention()._mesh()
+        q = jnp.zeros((2, 64, 4, 16), jnp.float32)
+        with _pytest.raises(ValueError):
+            ring_attention_sharded(
+                q, q, q, mesh, "seq", causal=False,
+                use_flash=True, layout="zigzag",
+            )
+        with _pytest.raises(ValueError):
+            ring_attention_sharded(
+                q, q, q, mesh, "seq", causal=True,
+                use_flash=False, layout="zigzag",
+            )
+
+    def test_schedule_is_balanced(self):
+        """The point of zigzag: per ring step, every device computes
+        the SAME number of sub-pairs.  Checked against the chunk-id
+        classification (q-chunk >= k-chunk computes) for several world
+        sizes."""
+        for n in (2, 4, 8):
+            per_device = []
+            for my in range(n):
+                q_ids = (my, 2 * n - 1 - my)
+                computed = 0
+                for i in range(n):
+                    src = (my - i) % n
+                    k_ids = (src, 2 * n - 1 - src)
+                    for qc in q_ids:
+                        for kc in k_ids:
+                            if qc >= kc:
+                                computed += 1
+                per_device.append(computed)
+            assert len(set(per_device)) == 1, (n, per_device)
+            # contiguous chunks, by contrast, are maximally unbalanced:
+            # device 0 computes 1 pair, device n-1 computes n
+            contiguous = [
+                sum(
+                    1
+                    for i in range(n)
+                    if ((my - i) % n) <= my
+                )
+                for my in range(n)
+            ]
+            assert len(set(contiguous)) == n  # all different
