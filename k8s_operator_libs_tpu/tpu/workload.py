@@ -72,6 +72,12 @@ class ModelConfig:
     #: flash block (128) to divide the local sequence; falls back to
     #: the einsum ring loudly otherwise.
     ring_flash: bool = False
+    #: With ``ring_flash``: "zigzag" runs the BALANCED causal ring —
+    #: each device holds global chunks (i, 2n-1-i) so every ring step
+    #: does equal work on every device (contiguous chunks leave device
+    #: n-1 doing n pairs while device 0 does one).  The attention seam
+    #: permutes in/out, so the model sees natural order.
+    ring_layout: str = "contiguous"
     #: Per-chip Pallas flash attention (:mod:`.flash_attention`): the
     #: kernel streams K/V blocks through VMEM with the online-softmax
     #: accumulator and prunes the causal k-loop — never materializing
@@ -239,6 +245,15 @@ class Block(nn.Module):
                             s_loc,
                         )
                         use_flash = False
+                layout = (
+                    cfg.ring_layout if use_flash else "contiguous"
+                )
+                s_loc_ring = max(
+                    1, query.shape[1] // ring_mesh.shape[cfg.seq_axis]
+                )
+                blk_cap = (
+                    s_loc_ring // 2 if layout == "zigzag" else s_loc_ring
+                )
                 return ring_attention_sharded(
                     query,
                     key,
@@ -248,7 +263,8 @@ class Block(nn.Module):
                     heads_axis=heads_axis,
                     causal=True,
                     use_flash=use_flash,
-                    flash_block=min(128, max(1, query.shape[1] // ring_mesh.shape[cfg.seq_axis])),
+                    flash_block=min(128, max(1, blk_cap)),
+                    layout=layout,
                 )
 
         elif cfg.flash_attention and (

@@ -1122,3 +1122,27 @@ class TestZigzagRingFlash:
                 for my in range(n)
             ]
             assert len(set(contiguous)) == n  # all different
+
+
+def test_tinylm_zigzag_ring_equals_contiguous():
+    """cfg.ring_layout="zigzag" swaps only the ring schedule — the
+    TinyLM loss on identical weights must match the contiguous
+    ring-flash exactly (same flax seam, natural-order activations)."""
+    jax, jnp, np, *_ = TestRingAttention._jax()
+    from k8s_operator_libs_tpu.tpu import workload as wl
+
+    mesh = wl.make_mesh(n_devices=8, dp=2, tp=1, sp=4)
+    base = dict(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=257, seq_axis="seq", ring_attention=True,
+        ring_flash=True,
+    )
+    losses = {}
+    for layout in ("contiguous", "zigzag"):
+        cfg = wl.ModelConfig(ring_layout=layout, **base)
+        with mesh:
+            model, params, tx, opt = wl.create_train_state(cfg, mesh)
+            step = wl.make_train_step(model, tx, mesh)
+            _p, _o, loss = step(params, opt, wl.make_batch(cfg, 4))
+        losses[layout] = float(loss)
+    assert abs(losses["contiguous"] - losses["zigzag"]) < 1e-4, losses
