@@ -39,8 +39,9 @@ class MultihostDrainLoop:
     *watcher* is the coordinator's
     :class:`~.drain_handshake.DrainSignalWatcher` (None on every other
     process); *save_fn(state, step)* checkpoints — called on EVERY
-    process (shadow-save pattern; see module docstring), with
-    ``is_coordinator`` available for target selection."""
+    process (shadow-save pattern; see module docstring).  Callers
+    close over their own process id for target selection
+    (:func:`shadow_dir`)."""
 
     def __init__(
         self,
@@ -62,15 +63,22 @@ class MultihostDrainLoop:
         self._poll_every = max(1, poll_every)
 
     def run(self, state) -> Tuple[Any, int, bool]:
-        """Returns ``(state, steps_done, drained)``."""
+        """Returns ``(state, steps_done, drained)``.
+
+        Exit conditions and divergence: ``max_steps`` is lockstep
+        (every process counts the same steps) so it may sit in the
+        loop condition; the WALL-CLOCK bound must not — local clocks
+        differ across processes, and a bare time check would let one
+        process leave the loop while a peer issues another collective
+        (deadlock).  The deadline therefore feeds the SAME polled
+        allreduce as the drain signal: any process past its local
+        deadline makes every process stop together (reported as
+        not-drained)."""
         sync_global_devices("multihost-loop-start")
         t0 = time.monotonic()
         step = 0
         drained = False
-        while (
-            step < self._max_steps
-            and time.monotonic() - t0 < self._max_seconds
-        ):
+        while step < self._max_steps:
             state, _loss = self._step_fn(state, step)
             step += 1
             if step % self._poll_every:
@@ -83,7 +91,13 @@ class MultihostDrainLoop:
                 )
                 else 0.0
             )
-            if host_allreduce_max(requested) > 0.0:
+            expired = time.monotonic() - t0 >= self._max_seconds
+            flag = host_allreduce_max(
+                max(requested, 2.0 if expired else 0.0)
+            )
+            if flag >= 2.0:
+                break  # some process's runaway deadline: stop, no drain
+            if flag > 0.0:
                 drained = True
                 break
         if drained:

@@ -233,27 +233,32 @@ class Block(nn.Module):
                     "model" if tp > 1 and query.shape[2] % tp == 0 else None
                 )
                 use_flash = cfg.ring_flash
+                layout = cfg.ring_layout if use_flash else "contiguous"
+                s_loc = max(
+                    1, query.shape[1] // ring_mesh.shape[cfg.seq_axis]
+                )
                 if use_flash:
-                    s_loc = query.shape[1] // ring_mesh.shape[cfg.seq_axis]
-                    blk = min(128, s_loc)
-                    if blk <= 0 or s_loc % blk:
+                    # the loud-fallback contract covers BOTH layouts:
+                    # zigzag additionally needs an even local sequence
+                    # and the block to divide the HALF chunk
+                    span = s_loc // 2 if layout == "zigzag" else s_loc
+                    blk = min(128, max(1, span))
+                    bad = span <= 0 or span % blk or (
+                        layout == "zigzag" and s_loc % 2
+                    )
+                    if bad:
                         _logging.getLogger(__name__).warning(
-                            "ring_flash: flash block %d does not divide "
-                            "the local sequence %d — falling back to the "
-                            "einsum ring for this shape",
+                            "ring_flash(%s): flash block %d does not "
+                            "tile the local sequence %d — falling back "
+                            "to the einsum ring for this shape",
+                            layout,
                             blk,
                             s_loc,
                         )
                         use_flash = False
-                layout = (
-                    cfg.ring_layout if use_flash else "contiguous"
-                )
-                s_loc_ring = max(
-                    1, query.shape[1] // ring_mesh.shape[cfg.seq_axis]
-                )
-                blk_cap = (
-                    s_loc_ring // 2 if layout == "zigzag" else s_loc_ring
-                )
+                        layout = "contiguous"
+                else:
+                    blk = min(128, s_loc)
                 return ring_attention_sharded(
                     query,
                     key,
@@ -263,7 +268,7 @@ class Block(nn.Module):
                     heads_axis=heads_axis,
                     causal=True,
                     use_flash=use_flash,
-                    flash_block=min(128, max(1, blk_cap)),
+                    flash_block=blk,
                     layout=layout,
                 )
 

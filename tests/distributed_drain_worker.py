@@ -56,7 +56,7 @@ def main() -> int:
         step_fn = wl.make_train_step(model, tx, mesh)
         trace("state created")
 
-        losses = []
+        last_loss = [None]
 
         def do_step(state, step):
             params, opt = state
@@ -64,7 +64,7 @@ def main() -> int:
                 cfg, batch_size=mesh.devices.size, seed=step
             )
             params, opt, loss = step_fn(params, opt, batch)
-            losses.append(loss)
+            last_loss[0] = loss
             return (params, opt), loss
 
         def do_save(state, step):
@@ -86,7 +86,9 @@ def main() -> int:
         )
         (params, opt), step, drained = loop.run((params, opt))
         trace(f"loop done at step {step} drained={drained}")
-        final_loss = float(losses[-1]) if losses else 0.0
+        final_loss = (
+            float(last_loss[0]) if last_loss[0] is not None else 0.0
+        )
     print(
         json.dumps(
             {
