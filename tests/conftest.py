@@ -18,6 +18,11 @@ import os
 # must be updated on the already-imported module, before any backend is
 # initialized by a first jax.devices()/jit call.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Defense in depth: an accelerator-tunnel PJRT plugin whose transport
+# has died HANGS inside backend discovery rather than erroring; the
+# suite must never dial it (the jax.config update below already pins
+# cpu, but the pool hint is cleared too so no plugin path can try).
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
