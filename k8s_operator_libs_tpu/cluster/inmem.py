@@ -90,13 +90,23 @@ def _key_of(obj: JsonObj) -> Key:
 
 
 def merge_patch(target: JsonObj, patch: JsonObj) -> JsonObj:
-    """RFC 7386 JSON merge patch: dicts merge recursively, null deletes."""
+    """RFC 7386 JSON merge patch: dicts merge recursively, null deletes.
+
+    The recursion follows the RFC's MergePatch pseudo-code exactly: a
+    patch SUB-OBJECT landing on a missing/non-object target merges into
+    ``{}`` — so nulls nested inside it are STRIPPED, never stored (a
+    real apiserver behaves the same; storing them would also break
+    idempotency, since a second application would then delete them).
+    Found by the hypothesis idempotency law in tests/test_properties.py."""
     out = dict(target)
     for k, v in patch.items():
         if v is None:
             out.pop(k, None)
-        elif isinstance(v, dict) and isinstance(out.get(k), dict):
-            out[k] = merge_patch(out[k], v)
+        elif isinstance(v, dict):
+            prev = out.get(k)
+            out[k] = merge_patch(
+                prev if isinstance(prev, dict) else {}, v
+            )
         else:
             out[k] = json_copy(v)
     return out

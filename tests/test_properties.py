@@ -1,0 +1,171 @@
+"""Property-based tests (hypothesis) for the algebraic cores.
+
+These pin INVARIANTS rather than examples: RFC 7386 merge-patch laws,
+IntOrString percent math bounds, the zigzag sequence permutation, and
+the store's copy-out fidelity — the places where a subtle edge (empty
+dict vs null, rounding at 0/100%, odd chunk counts) breaks quietly."""
+
+import json
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from k8s_operator_libs_tpu.api import IntOrString
+from k8s_operator_libs_tpu.cluster.inmem import json_copy, merge_patch
+
+# JSON-tree strategy: bounded depth/width so each case stays microsecond
+_scalars = st.one_of(
+    st.none(),
+    st.booleans(),
+    st.integers(-1000, 1000),
+    st.text(max_size=8),
+)
+_json = st.recursive(
+    _scalars,
+    lambda children: st.one_of(
+        st.lists(children, max_size=4),
+        st.dictionaries(st.text(max_size=6), children, max_size=4),
+    ),
+    max_leaves=12,
+)
+_objs = st.dictionaries(st.text(max_size=6), _json, max_size=5)
+# RFC 7386 patches: like objects, but None (JSON null) means "delete"
+_patches = _objs
+
+
+class TestMergePatchLaws:
+    @settings(max_examples=150, deadline=None)
+    @given(target=_objs, patch=_patches)
+    def test_idempotent(self, target, patch):
+        """Applying the same merge patch twice equals applying it once
+        (RFC 7386 patches are absolute, not incremental)."""
+        once = merge_patch(target, patch)
+        twice = merge_patch(once, patch)
+        assert once == twice
+
+    @settings(max_examples=150, deadline=None)
+    @given(target=_objs, patch=_patches)
+    def test_result_never_contains_null_values_from_patch(
+        self, target, patch
+    ):
+        """null in a patch DELETES — it must never appear as a stored
+        value at any level the patch touched."""
+        out = merge_patch(target, patch)
+
+        def check(node, pat):
+            if not isinstance(node, dict) or not isinstance(pat, dict):
+                return
+            for k, v in pat.items():
+                if v is None:
+                    assert k not in node
+                elif isinstance(v, dict):
+                    check(node.get(k), v)
+
+        check(out, patch)
+
+    @settings(max_examples=150, deadline=None)
+    @given(target=_objs, patch=_patches)
+    def test_target_not_mutated(self, target, patch):
+        before = json.dumps(target, sort_keys=True, default=str)
+        merge_patch(target, patch)
+        assert json.dumps(target, sort_keys=True, default=str) == before
+
+    @settings(max_examples=150, deadline=None)
+    @given(target=_objs)
+    def test_empty_patch_is_identity(self, target):
+        assert merge_patch(target, {}) == target
+
+
+class TestIntOrStringProperties:
+    @settings(max_examples=200, deadline=None)
+    @given(pct=st.integers(0, 100), total=st.integers(0, 10_000))
+    def test_percent_bounds_and_monotonicity(self, pct, total):
+        v = IntOrString(f"{pct}%")
+        up = v.scaled_value(total, round_up=True)
+        down = v.scaled_value(total, round_up=False)
+        assert 0 <= down <= up <= total
+        # exact endpoints
+        if pct == 0:
+            assert up == 0
+        if pct == 100:
+            assert down == total
+
+    @settings(max_examples=100, deadline=None)
+    @given(n=st.integers(-1000, 1000), total=st.integers(0, 10_000))
+    def test_int_passthrough(self, n, total):
+        assert IntOrString(n).scaled_value(total) == n
+
+    @settings(max_examples=50, deadline=None)
+    @given(s=st.text(max_size=6))
+    def test_garbage_strings_rejected(self, s):
+        import re
+
+        if re.fullmatch(r"(100|[0-9]{1,2})%", s):
+            return  # valid percent — not garbage
+        with pytest.raises((ValueError, TypeError)):
+            IntOrString(s)
+
+
+class TestZigzagPermutationProperties:
+    @settings(max_examples=60, deadline=None)
+    @given(
+        n=st.integers(1, 8),
+        chunk=st.integers(1, 4),
+        b=st.integers(1, 2),
+    )
+    def test_round_trip_and_chunk_placement(self, n, chunk, b):
+        from k8s_operator_libs_tpu.tpu.ring_attention import (
+            from_zigzag,
+            to_zigzag,
+        )
+
+        s = 2 * n * chunk
+        x = np.arange(b * s, dtype=np.float32).reshape(b, s, 1, 1)
+        import jax.numpy as jnp
+
+        z = to_zigzag(jnp.asarray(x), n)
+        # round trip is the identity
+        assert (np.asarray(from_zigzag(z, n)) == x).all()
+        # device i's shard is exactly global chunks (i, 2n-1-i)
+        zn = np.asarray(z)
+        per_dev = s // n
+        for i in range(n):
+            shard = zn[:, i * per_dev:(i + 1) * per_dev, 0, 0]
+            expect = np.concatenate(
+                [
+                    x[:, i * chunk:(i + 1) * chunk, 0, 0],
+                    x[
+                        :,
+                        (2 * n - 1 - i) * chunk:(2 * n - i) * chunk,
+                        0,
+                        0,
+                    ],
+                ],
+                axis=1,
+            )
+            assert (shard == expect).all(), (n, chunk, i)
+
+
+class TestCopyOutFidelity:
+    @settings(max_examples=80, deadline=None)
+    @given(obj=_objs)
+    def test_store_returns_equal_but_independent_objects(self, obj):
+        """get() hands out a deep copy equal to what was stored —
+        whether it travelled the marshal-blob fast path or the
+        json_copy fallback — and mutating it never touches the store."""
+        from k8s_operator_libs_tpu.cluster.inmem import InMemoryCluster
+
+        cluster = InMemoryCluster()
+        body = {
+            "kind": "ConfigMap",
+            "metadata": {"name": "x", "namespace": "d"},
+            "data": obj,
+        }
+        cluster.create(json_copy(body))
+        got = cluster.get("ConfigMap", "x", "d")
+        assert got["data"] == obj
+        got2 = cluster.get("ConfigMap", "x", "d")  # blob-cache hit path
+        assert got2["data"] == obj
+        got2["data"] = {"mutated": True}
+        assert cluster.get("ConfigMap", "x", "d")["data"] == obj
