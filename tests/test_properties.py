@@ -169,3 +169,83 @@ class TestCopyOutFidelity:
         assert got2["data"] == obj
         got2["data"] = {"mutated": True}
         assert cluster.get("ConfigMap", "x", "d")["data"] == obj
+
+
+class TestStrategicMergeLaws:
+    _containers = st.lists(
+        st.builds(
+            lambda n, img, port: {"name": f"c{n}", "image": img, "port": port},
+            st.integers(0, 4),
+            st.text(max_size=5),
+            st.integers(0, 100),
+        ),
+        max_size=4,
+        unique_by=lambda c: c["name"],
+    )
+
+    @settings(max_examples=120, deadline=None)
+    @given(tgt=_containers, pat=_containers)
+    def test_keyed_list_merge_idempotent(self, tgt, pat):
+        """Merging the same keyed-list patch twice equals once, and the
+        merge key stays unique in the result."""
+        from k8s_operator_libs_tpu.cluster.strategicmerge import (
+            strategic_merge,
+        )
+
+        target = {"spec": {"containers": tgt}}
+        patch = {"spec": {"containers": pat}}
+        once = strategic_merge(json_copy(target), patch, kind="Pod")
+        twice = strategic_merge(json_copy(once), patch, kind="Pod")
+        assert once == twice
+        names = [c["name"] for c in once["spec"]["containers"]]
+        assert len(names) == len(set(names))
+
+    @settings(max_examples=120, deadline=None)
+    @given(tgt=_containers, pat=_containers)
+    def test_keyed_merge_applies_patch_fields(self, tgt, pat):
+        """Every patched element ends up present with the patch's
+        fields winning; unpatched elements survive untouched."""
+        from k8s_operator_libs_tpu.cluster.strategicmerge import (
+            strategic_merge,
+        )
+
+        target = {"spec": {"containers": tgt}}
+        patch = {"spec": {"containers": pat}}
+        out = strategic_merge(json_copy(target), patch, kind="Pod")
+        by_name = {c["name"]: c for c in out["spec"]["containers"]}
+        for p in pat:
+            got = by_name[p["name"]]
+            for k, v in p.items():
+                assert got[k] == v
+        patched = {p["name"] for p in pat}
+        tgt_by_name = {c["name"]: c for c in tgt}
+        for name, c in tgt_by_name.items():
+            if name not in patched:
+                assert by_name[name] == c
+
+    @settings(max_examples=80, deadline=None)
+    @given(tgt=st.lists(st.integers(0, 9), max_size=5),
+           pat=st.lists(st.integers(0, 9), max_size=5))
+    def test_unregistered_list_is_atomic_replace(self, tgt, pat):
+        from k8s_operator_libs_tpu.cluster.strategicmerge import (
+            strategic_merge,
+        )
+
+        out = strategic_merge(
+            {"x": {"unregistered": tgt}},
+            {"x": {"unregistered": pat}},
+            kind="Pod",
+        )
+        assert out["x"]["unregistered"] == pat
+
+    @settings(max_examples=80, deadline=None)
+    @given(tgt=_containers, pat=_containers)
+    def test_target_not_mutated(self, tgt, pat):
+        from k8s_operator_libs_tpu.cluster.strategicmerge import (
+            strategic_merge,
+        )
+
+        target = {"spec": {"containers": tgt}}
+        before = json.dumps(target, sort_keys=True)
+        strategic_merge(target, {"spec": {"containers": pat}}, kind="Pod")
+        assert json.dumps(target, sort_keys=True) == before
