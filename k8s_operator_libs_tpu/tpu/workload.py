@@ -78,6 +78,13 @@ class ModelConfig:
     #: n-1 doing n pairs while device 0 does one).  The attention seam
     #: permutes in/out, so the model sees natural order.
     ring_layout: str = "contiguous"
+    #: Activation rematerialization: wrap every transformer block in
+    #: ``jax.checkpoint`` so the backward recomputes block activations
+    #: instead of keeping them resident — the standard HBM-for-FLOPs
+    #: trade that decides how long a sequence fits a chip.  Same loss;
+    #: gradients equal up to recompute rounding (different fusion
+    #: boundaries — tested to 1e-4).
+    remat: bool = False
     #: Per-chip Pallas flash attention (:mod:`.flash_attention`): the
     #: kernel streams K/V blocks through VMEM with the online-softmax
     #: accumulator and prunes the causal k-loop — never materializing
@@ -342,8 +349,13 @@ class TinyLM(nn.Module):
         )(positions)
         x = x + pos
         x = _seq_constrain(x, cfg, seq_sharded=True)
+        # remat: flax's lifted checkpoint wraps the BLOCK, so the
+        # backward recomputes each block's activations from its input
+        # instead of keeping them resident — same params/name tree
+        # (nn.remat preserves module names), bitwise-same loss
+        block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.n_layers):
-            x = Block(cfg, name=f"block_{i}")(x)
+            x = block_cls(cfg, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         return nn.Dense(cfg.vocab_size, dtype=cfg.dtype, name="lm_head")(x)
 

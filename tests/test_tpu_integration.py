@@ -1147,3 +1147,34 @@ def test_tinylm_zigzag_ring_equals_contiguous():
             _p, _o, loss = step(params, opt, wl.make_batch(cfg, 4))
         losses[layout] = float(loss)
     assert abs(losses["contiguous"] - losses["zigzag"]) < 1e-4, losses
+
+
+def test_remat_matches_unremat_loss_and_grads():
+    """cfg.remat wraps each block in flax's lifted jax.checkpoint:
+    identical param tree (nn.remat preserves names), identical loss,
+    gradients equal up to recompute rounding (the backward recomputes
+    activations through different fusion boundaries)."""
+    import dataclasses
+
+    jax, jnp, np, *_ = TestRingAttention._jax()
+    from k8s_operator_libs_tpu.tpu import workload as wl
+
+    cfg = wl.ModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=32,
+    )
+    model, params, _tx, _opt = wl.create_train_state(cfg)
+    model_r = wl.TinyLM(dataclasses.replace(cfg, remat=True))
+    batch = wl.make_batch(cfg, 4)
+    loss = lambda m: lambda p: wl.loss_fn(m, p, batch)  # noqa: E731
+    l1, g1 = jax.value_and_grad(loss(model))(params)
+    l2, g2 = jax.value_and_grad(loss(model_r))(params)
+    assert abs(float(l1) - float(l2)) < 1e-6
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        assert float(jnp.abs(a - b).max()) < 1e-4
+    # composes with the flash kernel seam
+    model_fr = wl.TinyLM(
+        dataclasses.replace(cfg, remat=True, flash_attention=True)
+    )
+    l3 = wl.loss_fn(model_fr, params, batch)
+    assert abs(float(l1) - float(l3)) < 1e-3
