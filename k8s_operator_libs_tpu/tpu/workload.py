@@ -515,6 +515,7 @@ def generate(
     temperature: float = 0.0,
     top_k: int = 0,
     seed: int = 0,
+    prompt_lens=None,
 ):
     """KV-cache decoding — the serving path.
 
@@ -532,13 +533,17 @@ def generate(
     pins the sample stream (per-step keys are folded from it), so a
     (seed, prompt) pair reproduces its continuation exactly.
 
-    *prompt* is [batch, prompt_len] int32 (one shared prompt length);
-    returns [batch, prompt_len + max_new_tokens] — prompt tokens are
-    teacher-forced, the rest decoded.  The whole loop is one
-    ``lax.scan`` under jit: static shapes, no host round trips per
-    token.  Decode mode is the unsharded per-chip path (serving
-    replicates by batch); MoE configs are supported, sharded/ring modes
-    are not (decode forces them off)."""
+    *prompt* is [batch, max_prompt_len] int32; with *prompt_lens*
+    ([batch] ints) prompts may be RAGGED — row i's real prompt is its
+    first ``prompt_lens[i]`` tokens (the padding beyond them is
+    ignored: decoding overwrites it), teacher-forcing ends per row.
+    Returns [batch, max_prompt_len + max_new_tokens] — each row decodes
+    ``max_new_tokens`` plus its share of the padding span.  The whole
+    loop is one ``lax.scan`` under jit: static shapes, no host round
+    trips per token; *prompt_lens* is a traced argument, so ragged
+    batches share one compiled loop.  Decode mode is the unsharded
+    per-chip path (serving replicates by batch); MoE configs are
+    supported, sharded/ring modes are not (decode forces them off)."""
     import dataclasses
 
     cfg = dataclasses.replace(
@@ -607,7 +612,7 @@ def generate(
     run = _decode_loop_cache.get(memo_key)
     if run is None:
 
-        def run_impl(p, cache, buf, temp, key):
+        def run_impl(p, cache, buf, temp, key, plens):
             if quantized:
                 p = dequantize_params(p, cfg.dtype)
 
@@ -633,8 +638,9 @@ def generate(
                     )
                 else:
                     nxt = jnp.argmax(last, axis=-1)
-                # teacher-force inside the prompt; decode beyond it
-                inside = i + 1 < prompt_len
+                # teacher-force inside each row's OWN prompt; decode
+                # beyond it (plens is [b] — ragged batches supported)
+                inside = i + 1 < plens
                 current = jax.lax.dynamic_slice_in_dim(
                     buf_c, i + 1, 1, axis=1
                 )[:, 0]
@@ -653,12 +659,21 @@ def generate(
         if len(_decode_loop_cache) >= 64:
             _decode_loop_cache.clear()
         _decode_loop_cache[memo_key] = run
+    if prompt_lens is None:
+        plens = jnp.full((b,), prompt_len, jnp.int32)
+    else:
+        plens = jnp.asarray(prompt_lens, jnp.int32)
+        if plens.shape != (b,):
+            raise ValueError(
+                f"prompt_lens must be [batch] = [{b}], got {plens.shape}"
+            )
     return run(
         params,
         cache,
         buf,
         jnp.asarray(max(temperature, 1e-6), jnp.float32),
         jax.random.key(seed),
+        plens,
     )
 
 
