@@ -552,6 +552,10 @@ def generate(
         seq_axis=None,
         ring_attention=False,
         flash_attention=False,
+        # remat trades memory for recompute in the BACKWARD; decode has
+        # none — a checkpoint wrapper would only obstruct fusion (and
+        # its absence from the loop memo key would alias compilations)
+        remat=False,
     )
     b, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
@@ -666,6 +670,14 @@ def generate(
         if plens.shape != (b,):
             raise ValueError(
                 f"prompt_lens must be [batch] = [{b}], got {plens.shape}"
+            )
+        host_lens = np.asarray(plens)
+        if host_lens.min() < 1 or host_lens.max() > prompt_len:
+            # out-of-range lengths would silently teacher-force the
+            # zero padding into the KV cache — garbage, not an error
+            raise ValueError(
+                f"prompt_lens must lie in [1, {prompt_len}], got "
+                f"{host_lens.tolist()}"
             )
     return run(
         params,

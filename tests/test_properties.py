@@ -101,8 +101,8 @@ class TestIntOrStringProperties:
     def test_garbage_strings_rejected(self, s):
         import re
 
-        if re.fullmatch(r"(100|[0-9]{1,2})%", s):
-            return  # valid percent — not garbage
+        if re.fullmatch(r"\d+%", s):
+            return  # IntOrString accepts any digit-run percent
         with pytest.raises((ValueError, TypeError)):
             IntOrString(s)
 
