@@ -10,7 +10,7 @@ IMAGE ?= k8s-operator-libs-tpu:dev
 BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 
 .PHONY: all test test-fast lint bench smoke graft-check cov-report clean help \
-	image .build-image kind-e2e tpu-smoke
+	image .build-image kind-e2e tpu-smoke tpu-probe tpu-watch
 
 all: lint test
 
@@ -75,6 +75,17 @@ kind-e2e:
 # demo trainer + checkpoint-on-drain handshake, step time + tokens/s.
 tpu-smoke:
 	$(PYTHON) hack/tpu_smoke.py
+
+# Fail-fast (≤60s) device probe: exit 0 iff a TPU answered.  Appends
+# the attempt to TPU_PROBE_LOG.jsonl either way.
+tpu-probe:
+	$(PYTHON) hack/tpu_probe.py
+
+# Probe for silicon at intervals for hours; run the full measurement
+# the moment the tunnel answers and persist it to TPU_SMOKE_LAST.json
+# (bench.py embeds the cache, age-labeled, when live capture fails).
+tpu-watch:
+	$(PYTHON) hack/tpu_watch.py
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

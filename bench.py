@@ -178,79 +178,182 @@ def run_rollout_http(policy: UpgradePolicySpec, max_cycles: int = 2000) -> float
         facade.stop()
 
 
-def tpu_section() -> dict:
-    """Measured TPU-silicon numbers (VERDICT r3 task 4) — or a skip
-    record when no chip is visible.  Never raises AND never hangs: the
-    accelerator runtime is reached through a tunnel whose failure mode
-    is a wedged (not erroring) ``import jax``, so the whole measurement
-    runs in a subprocess (hack/tpu_smoke.py) under a hard timeout —
-    the control-plane bench must survive a dead accelerator stack.
-    ``BENCH_TPU_TIMEOUT`` (seconds, default 900) bounds the subprocess."""
-    if os.environ.get("BENCH_SKIP_TPU"):
-        return {"skipped": True, "reason": "BENCH_SKIP_TPU set"}
-    import signal
-    import subprocess
-
-    script = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "hack", "tpu_smoke.py"
+def _probe_log_summary() -> dict:
+    """Summarize TPU_PROBE_LOG.jsonl — the round's proof of how many
+    times silicon was attempted (VERDICT r4 next #1: the artifact must
+    carry an attempt log even when every attempt failed)."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "TPU_PROBE_LOG.jsonl"
     )
+    attempts = ok = 0
+    first = last = last_reason = None
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                attempts += 1
+                if rec.get("ok"):
+                    ok += 1
+                else:
+                    last_reason = rec.get("reason")
+                ts = rec.get("ts")
+                first = first or ts
+                last = ts or last
+    except OSError:
+        pass
+    return {
+        "probe_attempts": attempts,
+        "probe_successes": ok,
+        "first_probe": first,
+        "last_probe": last,
+        "last_failure_reason": last_reason,
+    }
+
+
+def _cached_tpu_capture() -> dict | None:
+    """Load TPU_SMOKE_LAST.json (written by hack/tpu_watch.py when a
+    probe succeeded mid-round) and label it with its age — stale
+    silicon beats no silicon, but it must never masquerade as fresh."""
+    import datetime
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "TPU_SMOKE_LAST.json"
+    )
+    try:
+        with open(path, encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    rec = payload.get("measurement")
+    captured_at = payload.get("captured_at")
+    if not isinstance(rec, dict):
+        return None
+    age_h = None
+    try:
+        then = datetime.datetime.fromisoformat(
+            str(captured_at).replace("Z", "+00:00")
+        )
+        age_h = round(
+            (datetime.datetime.now(datetime.timezone.utc) - then)
+            .total_seconds()
+            / 3600.0,
+            1,
+        )
+    except (ValueError, TypeError):
+        # TypeError: a hand-edited tz-naive captured_at must not kill
+        # the bench over an optional cache file
+        pass
+    out = dict(rec.get("detail", rec))
+    out["cached"] = True
+    out["captured_at"] = captured_at
+    out["capture_age_hours"] = age_h
+    return out
+
+
+def tpu_section() -> dict:
+    """Measured TPU-silicon numbers — live if the tunnel answers NOW,
+    else the freshest cached capture from this round's watcher, else a
+    skip record carrying the round's probe-attempt log.
+
+    Four rounds of BENCH artifacts proved the tunnel wedges
+    intermittently (``import jax`` blocks in native code), so the old
+    single 840 s bench-time throw forfeited the round whenever the
+    wedge coincided with bench time.  Restructured per VERDICT r4
+    next #1: (a) a fail-fast ≤60 s device probe decides whether the
+    expensive measurement is even attempted; (b) hack/tpu_watch.py
+    retries the probe all round and persists any successful
+    measurement to TPU_SMOKE_LAST.json; (c) this section embeds that
+    cache (age-labeled) when live capture fails.  ``BENCH_TPU_TIMEOUT``
+    (seconds, default 900) bounds the live measurement subprocess."""
+    if os.environ.get("BENCH_SKIP_TPU"):
+        # unconditional, even when a cached capture exists: the skip
+        # env exists for deterministic hardware-free artifacts
+        return {"skipped": True, "reason": "BENCH_SKIP_TPU set"}
+
+    hack_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "hack")
+    # append (not insert) + guard: hack/ holds generically named modules
+    # (lint.py, typecheck.py) that must never shadow other imports
+    if hack_dir not in sys.path:
+        sys.path.append(hack_dir)
+    from tpu_probe import append_log, probe, run_json_child  # noqa: E402
+
+    probe_rec = probe(60.0)
+    append_log(probe_rec)
+    if not probe_rec.get("ok"):
+        out = _cached_tpu_capture()
+        reason = (
+            f"device probe failed: {probe_rec.get('reason')} "
+            f"(wall {probe_rec.get('wall_s')}s)"
+        )
+        if out is not None:
+            out["live_skip_reason"] = reason
+            out["probe_log"] = _probe_log_summary()
+            return out
+        return {
+            "skipped": True,
+            "reason": reason,
+            "probe_log": _probe_log_summary(),
+        }
+
+    script = os.path.join(hack_dir, "tpu_smoke.py")
     try:
         timeout_s = float(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
     except ValueError:
         timeout_s = 900.0
     # the smoke CLI's own watchdog gets a HEAD START so it fires first
-    # and reports a structured skip; ours is the backstop.  The child
-    # runs in its own process group so a backstop kill reaps the whole
-    # tree (the smoke CLI re-execs a grandchild; killing only the
-    # middle process would orphan a wedged jax import forever).
+    # and reports a structured skip; ours is the backstop.  Subprocess
+    # hygiene (own session, killpg, bounded reap, last-JSON-line parse)
+    # lives in tpu_probe.run_json_child, shared with probe and watcher.
     inner_timeout = max(30.0, timeout_s - 60.0)
-    try:
-        proc = subprocess.Popen(
-            [sys.executable, script, "--timeout", str(inner_timeout)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-            start_new_session=True,
+    res = run_json_child(
+        [sys.executable, script, "--timeout", str(inner_timeout)], timeout_s
+    )
+    rec = res["record"]
+    if res["status"] == "launch-error":
+        live_failure = f"tpu smoke failed to launch: {res['error']}"
+    elif res["status"] == "timeout":
+        live_failure = (
+            f"tpu smoke timed out after {timeout_s:.0f}s "
+            "(tunnel wedged between probe and measure)"
         )
-    except Exception as err:  # noqa: BLE001 — accelerator must not kill bench
-        return {"skipped": True, "reason": f"tpu smoke failed to launch: {err}"}
-    try:
-        stdout, stderr = proc.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
+    elif res["status"] == "exit":
+        live_failure = (
+            f"tpu smoke exited {res['returncode']}: {res['stderr_tail']}"
+        )
+    elif rec is None:
+        live_failure = "tpu smoke produced no JSON record"
+    elif rec.get("skipped"):
+        live_failure = rec.get("reason", "smoke skipped")
+    else:
+        # persist the capture BEFORE decorating the returned copy: the
+        # cache must hold only the measurement, or this round's
+        # probe_log would be served as a later round's proof of attempts
         try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except OSError:
-            proc.kill()
-        try:
-            # bounded: if killpg missed the grandchild, it still holds
-            # the pipe write ends and an unbounded communicate() would
-            # reintroduce the hang this path exists to eliminate
-            proc.communicate(timeout=10)
-        except (subprocess.TimeoutExpired, OSError):
+            from tpu_watch import persist
+
+            persist(rec)
+        except Exception:  # noqa: BLE001 — cache is best-effort
             pass
-        return {
-            "skipped": True,
-            "reason": f"tpu smoke timed out after {timeout_s:.0f}s "
-            "(wedged accelerator tunnel?)",
-        }
-    if proc.returncode != 0:
-        return {
-            "skipped": True,
-            "reason": "tpu smoke exited "
-            f"{proc.returncode}: {(stderr or '').strip()[-300:]}",
-        }
-    # last stdout line is the JSON record (warnings may precede it)
-    for line in reversed((stdout or "").strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if rec.get("skipped"):
-                return {"skipped": True, "reason": rec.get("reason", "")}
-            return rec.get("detail", rec)
-    return {"skipped": True, "reason": "tpu smoke produced no JSON record"}
+        out = dict(rec.get("detail", rec))
+        out["probe_log"] = _probe_log_summary()
+        return out
+
+    out = _cached_tpu_capture()
+    if out is not None:
+        out["live_skip_reason"] = live_failure
+        out["probe_log"] = _probe_log_summary()
+        return out
+    return {
+        "skipped": True,
+        "reason": live_failure,
+        "probe_log": _probe_log_summary(),
+    }
 
 
 def main() -> None:

@@ -85,10 +85,14 @@ def main() -> int:
         print(f"restored checkpoint step {restored['step']}")
     else:
         step = make_train_step(model, tx)
+        loss = None
         for i in range(args.steps):
             batch = make_batch(config, 8, seed=i)
             params, opt_state, loss = step(params, opt_state, batch)
-        print(f"trained {args.steps} steps, loss {float(loss):.4f}")
+        if loss is not None:
+            print(f"trained {args.steps} steps, loss {float(loss):.4f}")
+        else:
+            print("trained 0 steps (serving freshly initialized params)")
 
     rng = np.random.default_rng(args.seed)
     prompt = jnp.asarray(

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Fail-fast TPU device probe — is the accelerator tunnel answering?
+
+Four rounds of evidence (BENCH_r01..r04) show the tunnel's failure
+mode is a WEDGE, not an error: ``import jax`` / ``jax.devices()``
+blocks forever in native code.  A bench-time 840 s measurement attempt
+therefore forfeits the whole round's silicon evidence whenever the
+wedge happens to coincide with bench time (VERDICT r4 weak #4, next #1).
+
+This probe is the fix's first half: a tiny subprocess that tries
+device discovery under a HARD short timeout (default 60 s) and prints
+one JSON line either way:
+
+    {"ok": true,  "platform": "tpu", "n_devices": 1, "device_kind":
+     "...", "wall_s": 7.2, "ts": "..."}
+    {"ok": false, "reason": "probe timed out after 60s (wedged
+     accelerator tunnel)", "wall_s": 60.0, "ts": "..."}
+
+Every attempt is also appended to ``TPU_PROBE_LOG.jsonl`` at the repo
+root (override with ``--log``), so the round's bench artifact can
+PROVE how many times silicon was attempted even when every attempt
+failed.  ``--quiet`` suppresses stdout (the watcher tails the log).
+
+Exit code: 0 when a TPU answered, 1 when not (any reason) — usable as
+a shell predicate: ``python hack/tpu_probe.py && make tpu-smoke``.
+
+The parent process NEVER imports jax (that is the wedge).  The child
+clears ``JAX_PLATFORMS`` so a test-pinned ``cpu`` cannot mask a live
+chip, and runs in its own session so a timeout kill reaps the tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_LOG = os.path.join(REPO_ROOT, "TPU_PROBE_LOG.jsonl")
+
+# The child prints exactly one JSON line.  Platform filter matches
+# detect_tpu (k8s_operator_libs_tpu/tpu/smoke.py): only devices whose
+# platform is "tpu" count as silicon.
+_CHILD_SRC = (
+    "import json, jax\n"
+    "ds = jax.devices()\n"
+    "tpus = [d for d in ds if d.platform == 'tpu']\n"
+    "print(json.dumps({\n"
+    "    'platforms': sorted({d.platform for d in ds}),\n"
+    "    'n_tpu': len(tpus),\n"
+    "    'device_kind': tpus[0].device_kind if tpus else None,\n"
+    "}))\n"
+)
+
+
+def _utcnow() -> str:
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        .isoformat(timespec="seconds")
+        .replace("+00:00", "Z")
+    )
+
+
+def run_json_child(cmd: list, timeout_s: float, env: dict = None) -> dict:
+    """Run *cmd* with the full wedged-tunnel subprocess hygiene — own
+    session, SIGKILL of the whole process group on timeout, bounded
+    reap (an orphaned grandchild holding the pipe write ends must not
+    reintroduce the hang), last ``{``-prefixed stdout line parsed as
+    the JSON record.  The ONE implementation shared by the probe, the
+    watcher's measurement, and bench.py's tpu section.
+
+    Returns ``{"status": "ok"|"timeout"|"launch-error"|"exit",
+    "returncode", "record", "stderr_tail", "error"}`` — ``record`` is
+    the parsed JSON (or None), present regardless of exit status."""
+    out = {
+        "status": "ok",
+        "returncode": 0,
+        "record": None,
+        "stderr_tail": "",
+        "error": None,
+    }
+    try:
+        proc = subprocess.Popen(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+            start_new_session=True,
+        )
+    except Exception as err:  # noqa: BLE001 — caller must never hang/raise
+        out.update(status="launch-error", error=str(err))
+        return out
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
+        try:
+            proc.communicate(timeout=10)
+        except (subprocess.TimeoutExpired, OSError):
+            pass
+        out.update(status="timeout")
+        return out
+    out["returncode"] = proc.returncode
+    out["stderr_tail"] = (stderr or "").strip()[-300:]
+    if proc.returncode != 0:
+        out["status"] = "exit"
+    for line in reversed((stdout or "").strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            out["record"] = json.loads(line)
+            break
+        except ValueError:
+            continue
+    return out
+
+
+def probe(timeout_s: float = 60.0) -> dict:
+    """One discovery attempt in a throwaway subprocess.  Returns the
+    attempt record (always has ``ok``, ``wall_s``, ``ts``)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # never let a cpu pin hide the chip
+    t0 = time.monotonic()
+    rec: dict = {"ts": _utcnow(), "timeout_s": timeout_s}
+    res = run_json_child([sys.executable, "-c", _CHILD_SRC], timeout_s, env)
+    rec["wall_s"] = round(time.monotonic() - t0, 1)
+    if res["status"] == "launch-error":
+        rec.update(ok=False, reason=f"probe failed to launch: {res['error']}")
+    elif res["status"] == "timeout":
+        rec.update(
+            ok=False,
+            reason=f"probe timed out after {timeout_s:.0f}s "
+            "(wedged accelerator tunnel)",
+        )
+    elif res["status"] == "exit":
+        rec.update(
+            ok=False,
+            reason=f"probe exited {res['returncode']}: "
+            f"{res['stderr_tail'][-200:]}",
+        )
+    elif res["record"] is None:
+        rec.update(ok=False, reason="probe produced no JSON record")
+    else:
+        seen = res["record"]
+        if seen.get("n_tpu", 0) > 0:
+            rec.update(
+                ok=True,
+                platform="tpu",
+                n_devices=seen["n_tpu"],
+                device_kind=seen.get("device_kind"),
+            )
+        else:
+            rec.update(
+                ok=False,
+                reason="no TPU device "
+                f"(platforms seen: {seen.get('platforms')})",
+                platforms=seen.get("platforms"),
+            )
+    return rec
+
+
+def append_log(rec: dict, log_path: str = DEFAULT_LOG) -> None:
+    """Append one attempt record; best-effort (a read-only checkout
+    must not break the probe)."""
+    try:
+        with open(log_path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--timeout", type=float, default=60.0)
+    parser.add_argument("--log", default=DEFAULT_LOG)
+    parser.add_argument("--no-log", action="store_true")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args()
+    rec = probe(args.timeout)
+    if not args.no_log:
+        append_log(rec, args.log)
+    if not args.quiet:
+        print(json.dumps(rec))
+    return 0 if rec.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -102,6 +102,12 @@ def main() -> int:
         cmd.append("--allow-cpu")
     cmd += ["--steps", str(args.steps), "--batch-size", str(args.batch_size)]
     env = dict(os.environ, **{_CHILD_MARKER: "1"})
+    if not args.allow_cpu:
+        # a leaked test pin (JAX_PLATFORMS=cpu) would make the child's
+        # device discovery see only cpu and skip despite a live chip;
+        # --allow-cpu keeps the inherited env so a deliberate cpu
+        # measurement (the bench's compute floor) stays pinnable
+        env.pop("JAX_PLATFORMS", None)
     try:
         proc = subprocess.run(cmd, env=env, timeout=args.timeout)
     except subprocess.TimeoutExpired:

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""``make tpu-watch`` — keep probing for silicon all round long;
+measure the moment the tunnel answers.
+
+VERDICT r4 next #1(b): one 840 s measurement attempt per round has
+failed four rounds running because the accelerator tunnel wedges
+intermittently.  This watcher inverts the strategy: a cheap fail-fast
+probe (hack/tpu_probe.py, ≤60 s subprocess) retried at intervals for
+hours, and the EXPENSIVE measurement (hack/tpu_smoke.py) runs only
+after a probe succeeds — immediately, while the tunnel is known-alive.
+
+A successful measurement is persisted to ``TPU_SMOKE_LAST.json``
+(committed) with a capture timestamp; bench.py embeds it age-labeled
+whenever its own live capture fails, so one good capture anywhere in
+the round yields silicon numbers in the round's BENCH artifact.
+
+Usage:
+    python hack/tpu_watch.py                 # probe every 15 min until
+                                             # one measurement lands
+    python hack/tpu_watch.py --interval 300 --max-hours 10
+    python hack/tpu_watch.py --once          # single probe+measure try
+    python hack/tpu_watch.py --keep-going    # don't stop after success
+                                             # (refresh the capture)
+
+Every probe attempt appends to ``TPU_PROBE_LOG.jsonl`` — the round's
+proof of how often silicon was attempted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HACK_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(HACK_DIR)
+# append (not insert) + guard: hack/ holds generically named modules
+# (lint.py, typecheck.py) that must never shadow an importer's modules
+# when this file is imported (bench.py pulls persist() from here)
+if HACK_DIR not in sys.path:
+    sys.path.append(HACK_DIR)
+
+from tpu_probe import _utcnow, append_log, probe, run_json_child  # noqa: E402
+
+LAST_PATH = os.path.join(REPO_ROOT, "TPU_SMOKE_LAST.json")
+
+
+def run_measurement(timeout_s: float = 840.0) -> dict | None:
+    """Run the full smoke (train steps + drain handshake + kernel
+    timings) in a subprocess; return its parsed non-skip record, or
+    None.  Subprocess hygiene shared with the probe and bench via
+    :func:`tpu_probe.run_json_child`."""
+    script = os.path.join(HACK_DIR, "tpu_smoke.py")
+    inner = max(30.0, timeout_s - 60.0)
+    res = run_json_child(
+        [sys.executable, script, "--timeout", str(inner)], timeout_s
+    )
+    if res["status"] == "launch-error":
+        print(
+            f"tpu-watch: smoke failed to launch: {res['error']}",
+            file=sys.stderr,
+        )
+        return None
+    if res["status"] == "timeout":
+        print(
+            f"tpu-watch: measurement timed out after {timeout_s:.0f}s "
+            "(tunnel wedged between probe and measure)",
+            file=sys.stderr,
+        )
+        return None
+    rec = res["record"]
+    if rec is None:
+        if res["status"] == "exit":
+            print(
+                f"tpu-watch: smoke exited {res['returncode']}: "
+                f"{res['stderr_tail']}",
+                file=sys.stderr,
+            )
+        return None
+    if rec.get("skipped"):
+        print(f"tpu-watch: smoke skipped: {rec.get('reason')}")
+        return None
+    return rec
+
+
+def persist(rec: dict) -> str:
+    """Write the capture with its timestamp; atomic so a reader (bench)
+    never sees a torn file.  Silent — bench.py calls this on its
+    live-success path and must keep its one-JSON-line stdout contract;
+    callers print the returned path themselves."""
+    payload = {"captured_at": _utcnow(), "measurement": rec}
+    tmp = LAST_PATH + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1)
+        fh.write("\n")
+    os.replace(tmp, LAST_PATH)
+    return LAST_PATH
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--interval", type=float, default=900.0,
+                        help="seconds between probes (default 900)")
+    parser.add_argument("--probe-timeout", type=float, default=60.0)
+    parser.add_argument("--measure-timeout", type=float, default=840.0)
+    parser.add_argument("--max-hours", type=float, default=12.0)
+    parser.add_argument("--once", action="store_true",
+                        help="single probe (+measure on success), then exit")
+    parser.add_argument("--keep-going", action="store_true",
+                        help="keep refreshing the capture after a success")
+    args = parser.parse_args()
+
+    deadline = time.monotonic() + args.max_hours * 3600.0
+    attempt = 0
+    captured = False
+    while True:
+        attempt += 1
+        rec = probe(args.probe_timeout)
+        append_log(rec)
+        print(
+            f"tpu-watch: probe #{attempt} "
+            f"{'OK' if rec.get('ok') else 'no'} "
+            f"({rec.get('reason', rec.get('device_kind', ''))}) "
+            f"wall={rec.get('wall_s')}s",
+            flush=True,
+        )
+        if rec.get("ok"):
+            measurement = run_measurement(args.measure_timeout)
+            if measurement is not None:
+                path = persist(measurement)
+                print(f"tpu-watch: capture persisted to {path}")
+                captured = True
+                if not args.keep_going:
+                    return 0
+        if args.once:
+            return 0 if captured else 1
+        if time.monotonic() + args.interval > deadline:
+            return 0 if captured else 1
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,10 +70,13 @@ class MultihostDrainLoop:
         loop condition; the WALL-CLOCK bound must not — local clocks
         differ across processes, and a bare time check would let one
         process leave the loop while a peer issues another collective
-        (deadlock).  The deadline therefore feeds the SAME polled
-        allreduce as the drain signal: any process past its local
-        deadline makes every process stop together (reported as
-        not-drained)."""
+        (deadlock).  Both signals ride ONE polled max-allreduce with
+        the drain bit encoded ABOVE the deadline bit (requested=2,
+        expired=1), so a drain request wins even when it lands in the
+        same poll as a peer's expired wall-clock bound: checkpoint is
+        saved and acknowledged before exiting (the old requested=1 /
+        expired=2 encoding collapsed that pair to expired-only and
+        stalled the operator's drain, r4 advisor finding)."""
         sync_global_devices("multihost-loop-start")
         t0 = time.monotonic()
         step = 0
@@ -84,22 +87,18 @@ class MultihostDrainLoop:
             if step % self._poll_every:
                 continue
             requested = (
-                1.0
-                if (
-                    self._watcher is not None
-                    and self._watcher.checkpoint_requested()
-                )
-                else 0.0
+                self._watcher is not None
+                and self._watcher.checkpoint_requested()
             )
             expired = time.monotonic() - t0 >= self._max_seconds
             flag = host_allreduce_max(
-                max(requested, 2.0 if expired else 0.0)
+                2.0 if requested else (1.0 if expired else 0.0)
             )
             if flag >= 2.0:
-                break  # some process's runaway deadline: stop, no drain
-            if flag > 0.0:
-                drained = True
+                drained = True  # some process saw a drain request
                 break
+            if flag >= 1.0:
+                break  # some process's runaway deadline: stop, no drain
         if drained:
             self._save_fn(state, step)
         sync_global_devices("multihost-loop-done")

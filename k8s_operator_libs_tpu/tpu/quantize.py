@@ -27,6 +27,7 @@ from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _is_quant_node(node: Any) -> bool:
@@ -43,6 +44,18 @@ def quantize_params_int8(params) -> Dict[str, Any]:
     the total bytes and quantizing them costs accuracy for nothing."""
 
     def q(leaf):
+        # numpy leaves happen in practice: restore_checkpoint without a
+        # device_put yields np.ndarray params, and silently serving
+        # them full-precision while reporting 0 quantization error was
+        # the r4 advisor finding.  Convert ONLY leaves this function
+        # would quantize (>=2-D float) — everything else passes through
+        # with its type untouched, exactly as before
+        if (
+            isinstance(leaf, np.ndarray)
+            and leaf.ndim >= 2
+            and str(leaf.dtype) in ("float32", "float16", "bfloat16")
+        ):
+            leaf = jnp.asarray(leaf)
         if not isinstance(leaf, jnp.ndarray) or leaf.ndim < 2:
             return leaf
         if leaf.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):
@@ -79,6 +92,14 @@ def quantization_error(params, qparams) -> float:
     flat, _ = jax.tree.flatten(params)
     dflat, _ = jax.tree.flatten(deq)
     for a, b in zip(flat, dflat):
+        # same numpy normalization as quantize_params_int8: a restored
+        # (np.ndarray) tree must report its real error, not 0.0
+        if (
+            isinstance(a, np.ndarray)
+            and a.ndim >= 2
+            and str(a.dtype) in ("float32", "float16", "bfloat16")
+        ):
+            a = jnp.asarray(a)
         if not isinstance(a, jnp.ndarray) or a.ndim < 2:
             continue
         af = a.astype(jnp.float32)

@@ -273,8 +273,17 @@ class ClusterUpgradeStateManager:
     ) -> ClusterUpgradeState:
         common = self.common
         # fresh cycle: the DS-revision oracle re-reads ControllerRevisions
-        # once, then every per-node sync check this cycle hits the memo
-        self.pod_manager.reset_revision_memo()
+        # once, then every per-node sync check this cycle hits the memo.
+        # Clearing it is load-bearing on the real manager (a stale entry
+        # would judge sync against an outdated revision hash after a DS
+        # template edit — pod_manager.py:108-112), so the real PodManager
+        # is called directly and a rename breaks loudly; only injected
+        # duck-typed stubs predating the memo surface get the getattr
+        # escape (r4 advisor finding)
+        if isinstance(self.pod_manager, PodManager):
+            self.pod_manager.reset_revision_memo()
+        else:
+            getattr(self.pod_manager, "reset_revision_memo", lambda: None)()
         state = ClusterUpgradeState()
         daemon_sets = common.get_driver_daemon_sets(namespace, driver_labels)
         pods = self._reader.list(

@@ -255,6 +255,62 @@ class TestSpmdWorkload:
         restored = wl.restore_checkpoint(str(tmp_path), 3)
         assert restored["step"] == 3
 
+    def test_drain_request_wins_over_expired_deadline(self, monkeypatch):
+        """r4 advisor: a drain request landing in the SAME poll as an
+        expired wall-clock bound must still checkpoint + acknowledge —
+        the old single max-combined flag collapsed that pair to
+        expired-only and the operator's drain stalled."""
+        from k8s_operator_libs_tpu.tpu import multihost_trainer as mt
+
+        monkeypatch.setattr(mt, "host_allreduce_max", lambda v: v)
+        monkeypatch.setattr(
+            mt, "sync_global_devices", lambda *a, **k: None
+        )
+
+        class Watcher:
+            def __init__(self):
+                self.acked = False
+
+            def checkpoint_requested(self):
+                return True
+
+            def acknowledge(self):
+                self.acked = True
+
+        saves = []
+        watcher = Watcher()
+        loop = mt.MultihostDrainLoop(
+            lambda state, step: (state + 1, 0.0),
+            lambda state, step: saves.append(step),
+            watcher=watcher,
+            max_steps=100,
+            max_seconds=0.0,  # deadline expired at the very first poll
+        )
+        _state, steps, drained = loop.run(0)
+        assert drained is True
+        assert saves == [steps]
+        assert watcher.acked is True
+
+    def test_expired_deadline_alone_stops_without_drain(self, monkeypatch):
+        from k8s_operator_libs_tpu.tpu import multihost_trainer as mt
+
+        monkeypatch.setattr(mt, "host_allreduce_max", lambda v: v)
+        monkeypatch.setattr(
+            mt, "sync_global_devices", lambda *a, **k: None
+        )
+        saves = []
+        loop = mt.MultihostDrainLoop(
+            lambda state, step: (state + 1, 0.0),
+            lambda state, step: saves.append(step),
+            watcher=None,
+            max_steps=100,
+            max_seconds=0.0,
+        )
+        _state, steps, drained = loop.run(0)
+        assert drained is False
+        assert saves == []
+        assert steps == 1  # stopped at the first poll, not max_steps
+
     def test_sequence_parallel_train_step(self, jax_bits):
         """dp x sp x tp mesh: activations shard over the sequence axis in
         the MLP region (Megatron-style SP), gather for attention — XLA
@@ -857,6 +913,32 @@ class TestInt8WeightOnlyServing:
         # 1-D leaves (LayerNorm/bias) stay float
         ln = qp["ln_f"]["scale"]
         assert not isinstance(ln, dict)
+
+    def test_numpy_param_tree_quantizes_like_jax(self):
+        """r4 advisor: a tree straight from restore_checkpoint (numpy
+        leaves, no device_put) must quantize, not silently serve
+        full-precision while reporting zero error."""
+        jax, jnp, np, *_ = TestRingAttention._jax()
+        from k8s_operator_libs_tpu.tpu.quantize import (
+            quantization_error,
+            quantize_params_int8,
+        )
+
+        wl, cfg, params = self._trained()
+        np_params = jax.tree.map(np.asarray, jax.device_get(params))
+        qp = quantize_params_int8(np_params)
+        from k8s_operator_libs_tpu.tpu.quantize import _is_quant_node
+
+        quant_nodes = [
+            leaf
+            for leaf in jax.tree.leaves(qp, is_leaf=_is_quant_node)
+            if _is_quant_node(leaf)
+        ]
+        assert quant_nodes, "no leaf was quantized from a numpy tree"
+        # the error observable must also see numpy leaves (the advisor
+        # scenario reported 0.0 exactly here)
+        err = quantization_error(np_params, qp)
+        assert 0.0 < err < 0.02, err
 
     def test_quantized_decode_matches_fp_tokens(self):
         jax, jnp, np, *_ = TestRingAttention._jax()
