@@ -122,17 +122,27 @@ def best_of(n: int, fn) -> float:
     return min(fn() for _ in range(n))
 
 
-def run_rollout_http(policy: UpgradePolicySpec, max_cycles: int = 2000) -> float:
+def run_rollout_http(
+    policy: UpgradePolicySpec,
+    max_cycles: int = 2000,
+    fleet_builder=None,
+    max_list_page: int = 20,
+    write_pipeline_workers: int = 16,
+) -> tuple:
     """The production READ path over real HTTP: ApiServerFacade with a
-    server-enforced 20-item page cap (the 48-node fleet's Node/Pod
-    LISTs then really span 3+ pages each), a KubeApiClient whose held
-    watch streams feed the informer cache (the cache runs with the
-    SAME informer lag as the in-mem measurement, so its refreshes
-    drain the pushed frames via events_since — the informer-fed read
-    path, not direct GETs), and the same build/apply loop as the
-    in-mem measurement — so the two numbers isolate the transport +
-    pagination + held-stream cost.  Returns wall-clock seconds to
-    upgrade-done (fleet setup excluded)."""
+    server-enforced page cap (default 20 items, so the 48-node fleet's
+    Node/Pod LISTs really span 3+ pages each; the 1,024-node probe
+    uses the real apiserver's 500-item chunking), a KubeApiClient
+    whose held watch streams feed the informer cache (the cache runs
+    with the SAME informer lag as the in-mem measurement, so its
+    refreshes drain the pushed frames via events_since — the
+    informer-fed read path, not direct GETs), and the same build/apply
+    loop as the in-mem measurement — so the numbers isolate the
+    transport + pagination + held-stream cost.  Returns
+    ``(wall_seconds, requests_served)`` to upgrade-done; BOTH exclude
+    fleet setup (the request count subtracts a pre-loop reading of the
+    facade's cumulative counter), so requests/wall is loop-only
+    requests per second."""
     from k8s_operator_libs_tpu.cluster import (
         ApiServerFacade,
         KubeApiClient,
@@ -140,10 +150,10 @@ def run_rollout_http(policy: UpgradePolicySpec, max_cycles: int = 2000) -> float
     )
 
     store = InMemoryCluster()
-    facade = ApiServerFacade(store, max_list_page=20).start()
+    facade = ApiServerFacade(store, max_list_page=max_list_page).start()
     client = KubeApiClient(KubeConfig(server=facade.url), timeout=30.0)
     try:
-        fleet = build_fleet(client)
+        fleet = (fleet_builder or build_fleet)(client)
         client.start_held_watches(("Node", "Pod", "DaemonSet"))
         # kinds: the manager's working set — an unfiltered cache would
         # bounded-poll the 8 non-held registered kinds over HTTP on
@@ -158,12 +168,16 @@ def run_rollout_http(policy: UpgradePolicySpec, max_cycles: int = 2000) -> float
             client,
             cache=cache,
             cascade=True,
+            # a wave's node patches overlap over a bounded pool instead
+            # of paying one HTTP round trip each, sequentially
+            write_pipeline_workers=write_pipeline_workers,
             cache_sync_timeout_seconds=5.0,
             cache_sync_poll_seconds=0.005,
             # controller-runtime parity: snapshot reads ride the
             # held-stream-fed informer cache, not per-cycle HTTP LISTs
             reads_from_cache=True,
         )
+        served_before = facade.requests_served
         t0 = time.monotonic()
         for _ in range(max_cycles):
             state = manager.build_state(NAMESPACE, DRIVER_LABELS)
@@ -172,7 +186,10 @@ def run_rollout_http(policy: UpgradePolicySpec, max_cycles: int = 2000) -> float
             manager.pod_manager.wait_idle(30.0)
             fleet.reconcile_daemonset()
             if fleet.all_done():
-                return time.monotonic() - t0
+                return (
+                    time.monotonic() - t0,
+                    facade.requests_served - served_before,
+                )
         raise RuntimeError("HTTP rollout did not converge")
     finally:
         try:
@@ -465,9 +482,35 @@ def main() -> None:
     scale_4k_gcoff_rate, scale_4k_gcoff_s = scale_probe(1024, 4, tuned=False)
 
     # ---- HTTP path: the production loop over real localhost HTTP with
-    # server-enforced 500-item pages and held watch streams.
-    http_s = best_of(2, lambda: run_rollout_http(tuned_policy))
+    # server-enforced pages and held watch streams — the 48-node lagged
+    # fleet (20-item pages, r4 continuity) AND the 1,024-node probe
+    # (real apiserver 500-item chunking, operator GC profile) so the
+    # production path has an at-scale number, not just a toy one.
+    http_s, http_req = min(run_rollout_http(tuned_policy) for _ in range(2))
     http_rate = N_NODES / (http_s / 60.0)
+    with tuned_gc():
+        http_1k_s, http_1k_req = min(
+            run_rollout_http(
+                tuned_policy,
+                fleet_builder=lambda c: build_big_fleet(c, 256, 4),
+                max_list_page=500,
+            )
+            for _ in range(2)
+        )
+        # write-pipeline A/B at scale: the same probe with sequential
+        # node patches (the reference's per-write round trip pattern).
+        # best-of-2 on BOTH sides — min-of-2 vs single-sample would
+        # bias the ratio by the probe's own ±15% run noise.
+        http_1k_seq_s, _ = min(
+            run_rollout_http(
+                tuned_policy,
+                fleet_builder=lambda c: build_big_fleet(c, 256, 4),
+                max_list_page=500,
+                write_pipeline_workers=0,
+            )
+            for _ in range(2)
+        )
+    http_1k_rate = 1024 / (http_1k_s / 60.0)
 
     # vs_baseline is the ENGINE-honest ratio (full engine vs all
     # features off, same policy both sides — VERDICT r3 weak #4); the
@@ -485,9 +528,32 @@ def main() -> None:
                     "inmem_nodes_per_min": round(tuned_rate, 2),
                     "http_nodes_per_min": round(http_rate, 2),
                     "http_wall_s": round(http_s, 2),
+                    "http_requests_per_s": round(http_req / http_s, 1),
                     "http_config": (
                         "facade + held streams feeding the informer "
                         "cache + 20-item pages (3+ pages per LIST)"
+                    ),
+                    "http_scale_1024_nodes_per_min": round(http_1k_rate, 2),
+                    "http_scale_1024_wall_s": round(http_1k_s, 2),
+                    "http_scale_1024_requests_per_s": round(
+                        http_1k_req / http_1k_s, 1
+                    ),
+                    "http_scale_1024_config": (
+                        "facade + held streams + 500-item chunking "
+                        "(client-go pager default) + operator GC profile "
+                        "+ 16-worker write pipeline"
+                    ),
+                    "http_write_pipeline_speedup_1024n": round(
+                        http_1k_seq_s / http_1k_s, 3
+                    ),
+                    "http_scale_gap": (
+                        "vs in-mem: every node transition is a JSON "
+                        "merge-patch over HTTP (~1ms Python http stack "
+                        "round trip, ~14 requests/node incl. pod "
+                        "delete/create + eviction), where the in-mem "
+                        "store applies it in ~30us; the write pipeline "
+                        "overlaps the patches (A/B above), the rest is "
+                        "transport serialization"
                     ),
                     "policy_vs_default": round(tuned_rate / baseline_rate, 3),
                     "baseline_config_nodes_per_min": round(baseline_rate, 2),

@@ -190,6 +190,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             self._drain_body()
+            if self.apf_state is not None:
+                with self.apf_state["lock"]:
+                    self.apf_state["served"] += 1
             self._check_auth()
             (info, namespace, name, subresource), query = self._route()
             # Priority-and-fairness max-in-flight: a real apiserver sheds
@@ -577,12 +580,15 @@ class ApiServerFacade:
         #: Mutable: tests rotate the accepted set mid-run to force 401s
         #: (exec-plugin refresh path).  None = no auth required.
         self.accepted_tokens = accepted_tokens
-        #: Priority-and-fairness counters (shared with handler threads):
-        #: ``rejected`` counts load-shed 429s — the tests' observable.
+        #: Shared handler-thread counters: ``rejected`` counts APF
+        #: load-shed 429s (the tests' observable); ``served`` counts
+        #: every request that reached processing (chaos-dropped ones
+        #: excluded) — the bench's requests/sec numerator.
         self.apf_state = {
             "lock": threading.Lock(),
             "active": 0,
             "rejected": 0,
+            "served": 0,
         }
         self._handler_cls = type(
             "BoundHandler",
@@ -625,6 +631,13 @@ class ApiServerFacade:
     def url(self) -> str:
         host, port = self._server.server_address[:2]
         return f"http://{host}:{port}"
+
+    @property
+    def requests_served(self) -> int:
+        """Requests that reached processing since start (watch
+        establishments count once; chaos-dropped requests don't)."""
+        with self.apf_state["lock"]:
+            return self.apf_state["served"]
 
     def start(self) -> "ApiServerFacade":
         self._thread = threading.Thread(

@@ -45,6 +45,63 @@ class CacheSyncTimeoutError(Exception):
     """The write never became visible in the informer cache."""
 
 
+class _WritePipeline:
+    """Bookkeeping for :meth:`NodeUpgradeStateProvider.pipelined_writes`:
+    in-flight patch futures plus the (node, rv) visibility obligations
+    their completions produced.  Thread-safe — futures complete on pool
+    threads while the reconcile thread drains.
+
+    Same-name submissions are CHAINED: a write for node X waits for
+    X's previous in-flight write before patching, so per-node write
+    order equals submit order even within one phase (some phases issue
+    a label write and an annotation write for the same node — today
+    those merge-patches touch disjoint keys, but ordering must not
+    rest on that staying true).  Deadlock-free: the executor starts
+    tasks in submit (FIFO) order, so a chained task's predecessor is
+    always already running or done when the successor starts; the
+    chain head never waits."""
+
+    def __init__(self, pool) -> None:
+        self.pool = pool
+        self._lock = threading.Lock()
+        self._futures: List = []
+        self._rvs: List[Tuple[str, int]] = []
+        self._last_for_name: dict = {}
+
+    def submit(self, name: str, fn) -> None:
+        with self._lock:
+            prev = self._last_for_name.get(name)
+
+            def chained() -> None:
+                if prev is not None:
+                    try:
+                        prev.result()
+                    except BaseException:  # noqa: BLE001 — prev's own
+                        pass  # future carries it to the barrier
+                fn()
+
+            fut = self.pool.submit(chained)
+            self._futures.append(fut)
+            self._last_for_name[name] = fut
+
+    def add_rv(self, name: str, rv: int) -> None:
+        with self._lock:
+            self._rvs.append((name, rv))
+
+    def drain_futures(self) -> list:
+        with self._lock:
+            futures, self._futures = self._futures, []
+            self._last_for_name.clear()
+            return futures
+
+    def drain_rvs(self) -> List[Tuple[str, int]]:
+        """Call only after the drained futures have completed — a future
+        still in flight would add its rv after the drain."""
+        with self._lock:
+            rvs, self._rvs = self._rvs, []
+            return rvs
+
+
 class NodeUpgradeStateProvider:
     """Serialized, cache-visibility-checked node label/annotation writes."""
 
@@ -75,6 +132,8 @@ class NodeUpgradeStateProvider:
         # than label values keeps the wait satisfiable even when a later
         # writer (e.g. an async drain worker) overwrites the same key.
         self._local = threading.local()
+        #: Lazily created, provider-lifetime pool for pipelined_writes.
+        self._pipeline_pool = None
 
     # ------------------------------------------------------------- config
     def set_cache_sync_timeout(self, timeout_seconds: float) -> None:
@@ -102,21 +161,22 @@ class NodeUpgradeStateProvider:
         name = (node.get("metadata") or {}).get("name", "")
         key = util.get_upgrade_state_label_key()
         done_stamp = None
-        with self._keyed_mutex.lock(name):
-            if new_state == consts.UPGRADE_STATE_UNKNOWN:
-                patch: JsonObj = {"metadata": {"labels": {key: None}}}
-            else:
-                patch = {"metadata": {"labels": {key: new_state}}}
-            if new_state == consts.UPGRADE_STATE_DONE:
-                # done-at rides the SAME patch as the label: two writes
-                # could be split by a crash, leaving a done node with no
-                # stamp and wedging a canarySoakSeconds gate forever
-                done_stamp = repr(time.time())
-                patch["metadata"]["annotations"] = {
-                    util.get_done_at_annotation_key(): done_stamp
-                }
-            updated = self._cluster.patch("Node", name, patch)
-            self._wait_or_defer(name, _rv_of(updated))
+        if new_state == consts.UPGRADE_STATE_UNKNOWN:
+            patch: JsonObj = {"metadata": {"labels": {key: None}}}
+        else:
+            patch = {"metadata": {"labels": {key: new_state}}}
+        if new_state == consts.UPGRADE_STATE_DONE:
+            # done-at rides the SAME patch as the label: two writes
+            # could be split by a crash, leaving a done node with no
+            # stamp and wedging a canarySoakSeconds gate forever
+            done_stamp = repr(time.time())
+            patch["metadata"]["annotations"] = {
+                util.get_done_at_annotation_key(): done_stamp
+            }
+        if not self._submit_patch(name, patch):
+            with self._keyed_mutex.lock(name):
+                updated = self._cluster.patch("Node", name, patch)
+                self._wait_or_defer(name, _rv_of(updated))
         node.setdefault("metadata", {}).setdefault("labels", {})
         if new_state == consts.UPGRADE_STATE_UNKNOWN:
             node["metadata"]["labels"].pop(key, None)
@@ -149,17 +209,131 @@ class NodeUpgradeStateProvider:
         """
         name = (node.get("metadata") or {}).get("name", "")
         delete = value == consts.NULL_STRING
-        with self._keyed_mutex.lock(name):
-            patch_value = None if delete else value
-            updated = self._cluster.patch(
-                "Node", name, {"metadata": {"annotations": {key: patch_value}}}
-            )
-            self._wait_or_defer(name, _rv_of(updated))
+        patch_value = None if delete else value
+        patch = {"metadata": {"annotations": {key: patch_value}}}
+        if not self._submit_patch(name, patch):
+            with self._keyed_mutex.lock(name):
+                updated = self._cluster.patch("Node", name, patch)
+                self._wait_or_defer(name, _rv_of(updated))
         node.setdefault("metadata", {}).setdefault("annotations", {})
         if delete:
             node["metadata"]["annotations"].pop(key, None)
         else:
             node["metadata"]["annotations"][key] = value
+
+    # ------------------------------------------------- pipelined writes
+    @contextmanager
+    def pipelined_writes(self, max_workers: int = 16) -> Iterator[None]:
+        """Overlap this thread's node writes over a bounded pool.
+
+        Why: ApplyState's phase processors issue their label/annotation
+        patches node-after-node — semantically per-node-independent
+        (each node transitions at most once per phase, and the KeyedMutex
+        already serializes per node), but over real HTTP each patch costs
+        a network round trip, so a 1,024-node wave pays ~1,000 sequential
+        RTTs per phase.  Inside this block the patch round trip moves to
+        a worker pool while the caller-visible effects (the in-place node
+        mutation, the transition listener, metrics) stay on THIS thread
+        in submit order — cascade bucket migration and the transition
+        counter see exactly the sequence they would have seen
+        synchronously.
+
+        Correctness contract:
+
+        * :meth:`pipeline_barrier` MUST be called between phases: it
+          joins every in-flight patch (re-raising the first failure) and
+          converts their visibility obligations into this thread's
+          normal wait-or-defer flow.  Per-node write ORDER is preserved
+          everywhere: across phases by the barrier, within a phase by
+          per-name chaining in the pipeline (see :class:`_WritePipeline`).
+        * Thread-local, like :meth:`deferred_visibility`: async
+          drain/eviction workers writing through this provider remain
+          fully synchronous.
+        * Failure mode is deliberately "late": the node dict/listener
+          update happens optimistically at submit; a failed patch
+          surfaces at the barrier and aborts the pass.  The machine's
+          label-resident idempotency already covers exactly this (a
+          crash mid-pass loses nothing), and the next BuildState
+          re-derives truth from the cluster.
+
+        The pool is provider-lifetime (created on first use, resized
+        never — the first caller's *max_workers* wins) so a per-second
+        reconcile cadence doesn't pay thread spawn/join per pass;
+        :meth:`close` releases it for short-lived embedders.
+
+        Reference contrast: the reference has no analog (every write is
+        sequential and individually visibility-waited,
+        node_upgrade_state_provider.go:100-117); this is ICI-era
+        engineering for the same contract — same final states, same
+        observable order, round trips amortized.
+        """
+        if getattr(self._local, "pipeline", None) is not None:
+            yield  # nested: the outer block owns the pipeline
+            return
+        pool = self._pipeline_pool
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = ThreadPoolExecutor(
+                max_workers=max_workers, thread_name_prefix="node-write"
+            )
+            self._pipeline_pool = pool
+        self._local.pipeline = _WritePipeline(pool)
+        try:
+            yield
+            self.pipeline_barrier()
+        finally:
+            self._local.pipeline = None
+
+    def close(self) -> None:
+        """Release the pipeline worker pool (short-lived embedders; a
+        long-lived operator's pool lives as long as the process)."""
+        pool, self._pipeline_pool = self._pipeline_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+
+    def pipeline_barrier(self) -> None:
+        """Join every in-flight pipelined write from this thread: block
+        until the patches landed, hand their visibility waits to the
+        normal wait-or-defer flow, and re-raise the first patch failure
+        (after all have settled — later writes are never abandoned
+        mid-flight).  No-op outside a pipelined block."""
+        pipe = getattr(self._local, "pipeline", None)
+        if pipe is None:
+            return
+        first_err: Optional[BaseException] = None
+        for fut in pipe.drain_futures():
+            try:
+                fut.result()
+            except BaseException as err:  # noqa: BLE001 — collected, re-raised
+                if first_err is None:
+                    first_err = err
+        try:
+            for name, rv in pipe.drain_rvs():
+                self._wait_or_defer(name, rv)
+        except Exception as err:  # noqa: BLE001 — see below
+            # a cache-lag timeout while settling the waits must not MASK
+            # the real patch failure; without one it propagates normally
+            if first_err is None:
+                first_err = err
+        if first_err is not None:
+            raise first_err
+
+    def _submit_patch(self, name: str, patch: JsonObj) -> bool:
+        """Pipelined-mode write path: queue the locked patch + rv
+        bookkeeping on the pool; returns False when not pipelining (the
+        caller then writes synchronously)."""
+        pipe = getattr(self._local, "pipeline", None)
+        if pipe is None:
+            return False
+
+        def _do() -> None:
+            with self._keyed_mutex.lock(name):
+                updated = self._cluster.patch("Node", name, patch)
+            pipe.add_rv(name, _rv_of(updated))
+
+        pipe.submit(name, _do)
+        return True
 
     # ------------------------------------------------- transition listener
     @contextmanager
