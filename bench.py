@@ -26,8 +26,13 @@ informer lag, twice:
   ApiServerFacade with server-enforced 500-item pages + KubeApiClient
   held watch streams (the production read path) → ``detail.http_*``;
 * **TPU silicon** — the demo trainer's measured step time / tokens/s
-  plus the checkpoint-on-drain handshake, when a chip is visible
-  (``detail.tpu``; ``BENCH_SKIP_TPU=1`` skips).
+  plus the checkpoint-on-drain handshake, when a chip is visible —
+  probe-first with an age-labeled cached-capture fallback
+  (``detail.tpu``; ``BENCH_SKIP_TPU=1`` skips);
+* **CPU compute floor** — the same smoke pinned to the CPU backend
+  (train step-time, small decode, flash-interpret sanity), so compute
+  regressions stay visible with the tunnel down
+  (``detail.compute_cpu``; ``BENCH_SKIP_COMPUTE_CPU=1`` skips).
 
 Prints ONE JSON line: ``metric`` is the tuned nodes/min on the 48-node
 lagged fleet; ``vs_baseline`` is the ENGINE speedup (full engine vs
@@ -277,6 +282,74 @@ def _cached_tpu_capture() -> dict | None:
     return out
 
 
+def _hack_import():
+    """Import the hack/ probe module exactly once, with the append-not-
+    insert rule (hack/ holds generically named modules — lint.py,
+    typecheck.py — that must never shadow other imports).  Returns
+    (hack_dir, tpu_probe module)."""
+    hack_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "hack")
+    if hack_dir not in sys.path:
+        sys.path.append(hack_dir)
+    import tpu_probe  # noqa: E402
+
+    return hack_dir, tpu_probe
+
+
+def _env_timeout(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def compute_cpu_section() -> dict:
+    """Platform-labeled CPU compute floor (VERDICT r4 next #5): the
+    same smoke measurement as the tpu section but pinned to the CPU
+    backend — train step-time, a small decode-throughput number, and
+    the flash-kernel interpret sanity check — so every BENCH artifact
+    carries SOME compute signal and kernel/decode regressions are
+    visible round-over-round even when the accelerator tunnel is down.
+    The cpu label is structural (tpu_smoke reports the real platform);
+    a CPU number can never masquerade as silicon.
+    ``BENCH_SKIP_COMPUTE_CPU=1`` skips; ``BENCH_COMPUTE_CPU_TIMEOUT``
+    (seconds, default 600) bounds the subprocess."""
+    if os.environ.get("BENCH_SKIP_COMPUTE_CPU"):
+        return {"skipped": True, "reason": "BENCH_SKIP_COMPUTE_CPU set"}
+    hack_dir, tpu_probe = _hack_import()
+    run_json_child = tpu_probe.run_json_child
+    timeout_s = _env_timeout("BENCH_COMPUTE_CPU_TIMEOUT", 600.0)
+    env = dict(os.environ)
+    # pin the CPU backend AND clear the accelerator pool hint — with a
+    # wedged tunnel the PJRT plugin hook hangs inside import jax even
+    # when JAX_PLATFORMS=cpu (tests/conftest.py documents the same)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    script = os.path.join(hack_dir, "tpu_smoke.py")
+    res = run_json_child(
+        [
+            sys.executable, script, "--allow-cpu", "--steps", "5",
+            "--timeout", str(max(30.0, timeout_s - 30.0)),
+        ],
+        timeout_s,
+        env,
+    )
+    rec = res["record"]
+    if res["status"] == "timeout":
+        return {
+            "skipped": True,
+            "reason": f"cpu smoke timed out after {timeout_s:.0f}s",
+        }
+    if res["status"] != "ok" or rec is None:
+        return {
+            "skipped": True,
+            "reason": f"cpu smoke {res['status']}: "
+            f"{(res.get('error') or res.get('stderr_tail') or '')[-300:]}",
+        }
+    if rec.get("skipped"):
+        return {"skipped": True, "reason": rec.get("reason", "")}
+    return rec.get("detail", rec)
+
+
 def tpu_section() -> dict:
     """Measured TPU-silicon numbers — live if the tunnel answers NOW,
     else the freshest cached capture from this round's watcher, else a
@@ -297,15 +370,11 @@ def tpu_section() -> dict:
         # env exists for deterministic hardware-free artifacts
         return {"skipped": True, "reason": "BENCH_SKIP_TPU set"}
 
-    hack_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "hack")
-    # append (not insert) + guard: hack/ holds generically named modules
-    # (lint.py, typecheck.py) that must never shadow other imports
-    if hack_dir not in sys.path:
-        sys.path.append(hack_dir)
-    from tpu_probe import append_log, probe, run_json_child  # noqa: E402
+    hack_dir, tpu_probe = _hack_import()
+    run_json_child = tpu_probe.run_json_child
 
-    probe_rec = probe(60.0)
-    append_log(probe_rec)
+    probe_rec = tpu_probe.probe(60.0)
+    tpu_probe.append_log(probe_rec)
     if not probe_rec.get("ok"):
         out = _cached_tpu_capture()
         reason = (
@@ -323,10 +392,7 @@ def tpu_section() -> dict:
         }
 
     script = os.path.join(hack_dir, "tpu_smoke.py")
-    try:
-        timeout_s = float(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
-    except ValueError:
-        timeout_s = 900.0
+    timeout_s = _env_timeout("BENCH_TPU_TIMEOUT", 900.0)
     # the smoke CLI's own watchdog gets a HEAD START so it fires first
     # and reports a structured skip; ours is the backstop.  Subprocess
     # hygiene (own session, killpg, bounded reap, last-JSON-line parse)
@@ -561,6 +627,7 @@ def main() -> None:
                     "tuned_wall_s": round(tuned_s, 2),
                     "informer_lag_s": INFORMER_LAG_S,
                     "tpu": tpu_section(),
+                    "compute_cpu": compute_cpu_section(),
                     "engine": {
                         "speedup_full_vs_all_off": round(
                             engine_all_off_s / engine_full_s, 3

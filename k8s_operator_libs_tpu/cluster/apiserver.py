@@ -190,9 +190,6 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             self._drain_body()
-            if self.apf_state is not None:
-                with self.apf_state["lock"]:
-                    self.apf_state["served"] += 1
             self._check_auth()
             (info, namespace, name, subresource), query = self._route()
             # Priority-and-fairness max-in-flight: a real apiserver sheds
@@ -213,6 +210,12 @@ class _Handler(BaseHTTPRequestHandler):
                         return
                     apf["active"] += 1
             try:
+                # served = authenticated AND admitted (past the APF
+                # gate) — shed/unauthorized requests must not inflate a
+                # requests/sec numerator built on this counter
+                if self.apf_state is not None:
+                    with self.apf_state["lock"]:
+                        self.apf_state["served"] += 1
                 handler = getattr(self, f"_handle_{method}")
                 handler(info, namespace, name, subresource, query)
             finally:

@@ -278,12 +278,24 @@ class NodeUpgradeStateProvider:
                 max_workers=max_workers, thread_name_prefix="node-write"
             )
             self._pipeline_pool = pool
-        self._local.pipeline = _WritePipeline(pool)
+        pipe = _WritePipeline(pool)
+        self._local.pipeline = pipe
         try:
             yield
             self.pipeline_barrier()
         finally:
             self._local.pipeline = None
+            # a mid-phase error skips the barrier above — JOIN the
+            # in-flight patches anyway (discarding results): a stale
+            # queued write landing DURING the next reconcile could
+            # overwrite that pass's fresh write and regress a node's
+            # state (KeyedMutex serializes, it does not order)
+            for fut in pipe.drain_futures():
+                try:
+                    fut.result()
+                except BaseException:  # noqa: BLE001 — body error wins
+                    pass
+            pipe.drain_rvs()
 
     def close(self) -> None:
         """Release the pipeline worker pool (short-lived embedders; a

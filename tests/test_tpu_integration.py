@@ -477,6 +477,13 @@ class TestTpuSmokeHarness:
         assert result["platform"] == "cpu"
         assert result["step_time_ms"] > 0
         assert result["tokens_per_s"] > 0
+        # CPU floor sections (bench compute_cpu): the kernel sanity
+        # check must run and agree with the dense oracle; decode is
+        # absent here (tiny max_seq_len leaves no token budget)
+        fi = result["flash_interpret"]
+        assert "error" not in fi, fi
+        assert fi["max_abs_err"] < 2e-3
+        assert "decode" not in result
         hs = result["drain_handshake"]
         assert hs["ack"] == "done"
         assert hs["checkpoint_step"] == 2
