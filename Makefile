@@ -10,7 +10,7 @@ IMAGE ?= k8s-operator-libs-tpu:dev
 BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 
 .PHONY: all test test-fast lint bench smoke graft-check cov-report clean help \
-	image .build-image kind-e2e tpu-smoke tpu-probe tpu-watch
+	image .build-image kind-e2e kind-e2e-stub tpu-smoke tpu-probe tpu-watch
 
 all: lint test
 
@@ -70,6 +70,20 @@ docker-%: .build-image
 # Needs docker + kind + kubectl on the host (CI job: kind-e2e).
 kind-e2e:
 	bash hack/kind-e2e.sh
+
+# The same script with hack/e2e_stubs on PATH: no docker/kind needed —
+# the convergence loop runs the REAL operator process against a live
+# ApiServerFacade with a fake DS-controller/kubelet (see
+# hack/e2e_stubs/README.md).  Writes KIND_E2E_RESULT.json.
+# && before the artifact write: a failed e2e must FAIL the target (no
+# pipefail in /bin/sh — a pipeline would exit with tee's 0) and must
+# never overwrite KIND_E2E_RESULT.json with a partial run's output.
+kind-e2e-stub:
+	@STATE=$$(mktemp -d) && OUT=$$STATE/stdout.txt && \
+	E2E_STUB_DIR=$$STATE PATH="$(CURDIR)/hack/e2e_stubs:$$PATH" \
+	E2E_CLUSTER_DESC="stub: ApiServerFacade over HTTP + fake DS-controller/kubelet + REAL operator process (hack/e2e_stubs)" \
+	E2E_POLL_S=1 bash hack/kind-e2e.sh > $$OUT && \
+	tail -n 1 $$OUT | tee KIND_E2E_RESULT.json
 
 # Run the TPU layer on real TPU silicon (skips cleanly when no chip):
 # demo trainer + checkpoint-on-drain handshake, step time + tokens/s.

@@ -585,8 +585,10 @@ class ApiServerFacade:
         self.accepted_tokens = accepted_tokens
         #: Shared handler-thread counters: ``rejected`` counts APF
         #: load-shed 429s (the tests' observable); ``served`` counts
-        #: every request that reached processing (chaos-dropped ones
-        #: excluded) — the bench's requests/sec numerator.
+        #: requests that were authenticated, routed, AND admitted past
+        #: the APF gate — chaos-dropped, 401, unroutable, and shed
+        #: requests are all excluded, so it is a clean requests/sec
+        #: numerator for the bench.
         self.apf_state = {
             "lock": threading.Lock(),
             "active": 0,
@@ -637,8 +639,9 @@ class ApiServerFacade:
 
     @property
     def requests_served(self) -> int:
-        """Requests that reached processing since start (watch
-        establishments count once; chaos-dropped requests don't)."""
+        """Requests authenticated, routed, and APF-admitted since start
+        (watch establishments count once; chaos-dropped, 401, and
+        load-shed requests never count)."""
         with self.apf_state["lock"]:
             return self.apf_state["served"]
 
