@@ -391,12 +391,15 @@ def tpu_section() -> dict:
             "probe_log": _probe_log_summary(),
         }
 
-    script = os.path.join(hack_dir, "tpu_smoke.py")
+    # STAGED capture (hack/tpu_stage.py): each measurement stage runs
+    # in its own subprocess with its own timeout and is persisted the
+    # moment it lands — the r5 tunnel wedged at minute 13 of the
+    # monolithic smoke WITH the probe having passed, so the bet-
+    # everything-on-one-subprocess shape forfeits partial evidence.
+    # The runner's --timeout is its global budget; it trims stages to
+    # fit and its own watchdogs fire before ours.
+    script = os.path.join(hack_dir, "tpu_stage.py")
     timeout_s = _env_timeout("BENCH_TPU_TIMEOUT", 900.0)
-    # the smoke CLI's own watchdog gets a HEAD START so it fires first
-    # and reports a structured skip; ours is the backstop.  Subprocess
-    # hygiene (own session, killpg, bounded reap, last-JSON-line parse)
-    # lives in tpu_probe.run_json_child, shared with probe and watcher.
     inner_timeout = max(30.0, timeout_s - 60.0)
     res = run_json_child(
         [sys.executable, script, "--timeout", str(inner_timeout)], timeout_s

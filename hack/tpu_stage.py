@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Staged TPU-silicon capture — bank each number the moment it lands.
+
+Round-5 evidence forced this design: the fail-fast probe answered in
+2.5 s ("TPU v5 lite") and the monolithic ``hack/tpu_smoke.py``
+measurement then wedged at minute 13, forfeiting every number at once
+— the tunnel can wedge BETWEEN probe and measure, mid-measure, any
+time.  The counter is to stop betting the whole capture on one
+subprocess:
+
+* each stage (``matmul`` → ``train`` → ``attention`` → ``decode`` →
+  ``drain``, cheapest first) runs in its OWN subprocess with its OWN
+  timeout (a wedge costs that stage, nothing else);
+* after every successful stage the merged record is persisted to
+  ``TPU_SMOKE_LAST.json`` via :func:`tpu_watch.persist` — bench.py
+  embeds it age-labeled, so one banked stage anywhere in the round
+  beats five perfect stages that never returned;
+* after a stage timeout the tunnel is re-probed (≤60 s); if the probe
+  fails the remaining stages are skipped instead of queueing more
+  dead 300 s waits.
+
+Prints ONE JSON line: the merged measurement (per-stage status under
+``stages``); ``skipped: true`` only when no stage landed.  Exit 0 if
+at least one stage produced a number.
+
+Usage:
+    python hack/tpu_stage.py                     # all stages
+    python hack/tpu_stage.py --stages matmul,train
+    python hack/tpu_stage.py --timeout 600       # global budget (s)
+    python hack/tpu_stage.py --allow-cpu         # platform-labeled CPU
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HACK_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(HACK_DIR)
+if HACK_DIR not in sys.path:
+    sys.path.append(HACK_DIR)  # append, not insert: see tpu_watch.py
+
+from tpu_probe import append_log, probe, run_json_child  # noqa: E402
+from tpu_watch import persist  # noqa: E402
+
+_CHILD_MARKER = "_TPU_STAGE_CHILD"
+
+#: Per-stage subprocess timeouts (seconds): jax import + compile + the
+#: measurement itself.  Override with TPU_STAGE_TIMEOUT_<STAGE>.
+DEFAULT_TIMEOUTS = {
+    "touch": 120.0,
+    "matmul": 240.0,
+    "train": 420.0,
+    "attention": 420.0,
+    "decode": 360.0,
+    "drain": 360.0,
+}
+
+#: Keys a stage child reports that merge into the record TOP LEVEL
+#: (everything else nests under its own key already).
+_TOP_LEVEL = (
+    "platform",
+    "device_kind",
+    "touch",
+    "step_time_ms",
+    "tokens_per_s",
+    "model",
+    "final_loss",
+    "matmul",
+    "attention_kernel",
+    "decode",
+    "drain_handshake",
+)
+
+
+def _stage_timeout(stage: str) -> float:
+    env = os.environ.get(f"TPU_STAGE_TIMEOUT_{stage.upper()}")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    return DEFAULT_TIMEOUTS.get(stage, 300.0)
+
+
+def _child(stage: str, allow_cpu: bool) -> int:
+    """Runs inside the stage subprocess: measure, print one JSON line."""
+    sys.path.insert(0, REPO_ROOT)
+    from k8s_operator_libs_tpu.tpu.smoke import detect_tpu, run_stage
+
+    if detect_tpu() is None and not allow_cpu:
+        print(json.dumps({"skipped": True, "reason": "no TPU visible"}))
+        return 0
+    print(json.dumps(run_stage(stage)))
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--stages", default=",".join(DEFAULT_TIMEOUTS),
+                        help="comma-separated stage list, run in order")
+    parser.add_argument("--timeout", type=float, default=0.0,
+                        help="global budget in seconds (0 = sum of the "
+                        "per-stage timeouts)")
+    parser.add_argument("--allow-cpu", action="store_true",
+                        help="measure on CPU when no TPU is present "
+                        "(records stay platform-labeled)")
+    parser.add_argument("--no-persist", action="store_true",
+                        help="do not write TPU_SMOKE_LAST.json "
+                        "(script self-tests)")
+    parser.add_argument("--child", default="", help=argparse.SUPPRESS)
+    args = parser.parse_args()
+
+    if args.child:
+        return _child(args.child, args.allow_cpu)
+
+    stages = [s.strip() for s in args.stages.split(",") if s.strip()]
+    deadline = (
+        time.monotonic() + args.timeout if args.timeout > 0 else None
+    )
+
+    record: dict = {"staged": True, "stages": {}}
+    env = dict(os.environ)
+    # never inherit a test-pinned cpu backend; the child decides via
+    # detect_tpu + --allow-cpu (tpu_probe hygiene, same rule)
+    if not args.allow_cpu:
+        env.pop("JAX_PLATFORMS", None)
+    banked = 0
+    for i, stage in enumerate(stages):
+        timeout_s = _stage_timeout(stage)
+        if deadline is not None:
+            left = deadline - time.monotonic()
+            if left < 60.0:
+                for rest in stages[i:]:
+                    record["stages"][rest] = "skipped: budget exhausted"
+                break
+            timeout_s = min(timeout_s, left)
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--child", stage]
+        if args.allow_cpu:
+            cmd.append("--allow-cpu")
+        t0 = time.monotonic()
+        res = run_json_child(cmd, timeout_s, env)
+        wall = round(time.monotonic() - t0, 1)
+        rec = res.get("record")
+        if res["status"] == "ok" and rec and not rec.get("skipped"):
+            for key in _TOP_LEVEL:
+                if key in rec:
+                    record[key] = rec[key]
+            record["stages"][stage] = f"ok ({wall}s)"
+            banked += 1
+            if not args.no_persist:
+                persist(record)
+            print(f"tpu-stage: {stage} ok in {wall}s", file=sys.stderr)
+            continue
+        if res["status"] == "timeout":
+            record["stages"][stage] = f"timeout after {timeout_s:.0f}s"
+            print(
+                f"tpu-stage: {stage} timed out after {timeout_s:.0f}s",
+                file=sys.stderr,
+            )
+            # the tunnel may be gone: don't queue more dead waits
+            # unless a quick probe says it answers.  The probe itself
+            # must fit the global budget — overrunning it would eat the
+            # outer caller's (bench's) watchdog headroom and get this
+            # process SIGKILLed before the final JSON line prints.
+            if deadline is not None and deadline - time.monotonic() < 65.0:
+                for rest in stages[i + 1:]:
+                    record["stages"][rest] = "skipped: budget exhausted"
+                break
+            if stage != stages[-1]:
+                p = probe(60.0)
+                append_log(p)  # the round's attempt-evidence log
+                if not p.get("ok"):
+                    for rest in stages[i + 1:]:
+                        record["stages"][rest] = (
+                            "skipped: tunnel wedged (post-timeout probe "
+                            "failed)"
+                        )
+                    break
+        elif rec and rec.get("skipped"):
+            record["stages"][stage] = f"skipped: {rec.get('reason')}"
+        else:
+            tail = (res.get("error") or res.get("stderr_tail") or "")[-200:]
+            record["stages"][stage] = f"{res['status']}: {tail}"
+            print(f"tpu-stage: {stage} failed: {tail}", file=sys.stderr)
+
+    if banked == 0:
+        record["skipped"] = True
+        record["reason"] = "no stage produced a measurement"
+    print(json.dumps(record))
+    return 0 if banked else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,11 +48,14 @@ LAST_PATH = os.path.join(REPO_ROOT, "TPU_SMOKE_LAST.json")
 
 
 def run_measurement(timeout_s: float = 840.0) -> dict | None:
-    """Run the full smoke (train steps + drain handshake + kernel
-    timings) in a subprocess; return its parsed non-skip record, or
-    None.  Subprocess hygiene shared with the probe and bench via
-    :func:`tpu_probe.run_json_child`."""
-    script = os.path.join(HACK_DIR, "tpu_smoke.py")
+    """Run the STAGED capture (hack/tpu_stage.py) in a subprocess;
+    return its parsed non-skip record, or None.  The stage runner
+    persists each banked stage itself, so even a None return here can
+    leave fresh numbers in TPU_SMOKE_LAST.json — exactly the point
+    (the r5 wedge killed a monolithic smoke at minute 13 with zero
+    numbers banked).  Subprocess hygiene shared with the probe and
+    bench via :func:`tpu_probe.run_json_child`."""
+    script = os.path.join(HACK_DIR, "tpu_stage.py")
     inner = max(30.0, timeout_s - 60.0)
     res = run_json_child(
         [sys.executable, script, "--timeout", str(inner)], timeout_s

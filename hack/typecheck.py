@@ -19,7 +19,20 @@ a library like this one:
 * **self-attribute existence**: ``self.foo`` reads in a class that
   never assigns ``foo`` anywhere (methods, class body, any method's
   ``self.foo = ...``) — the classic typo'd-attribute NameError waiting
-  for a rare code path.
+  for a rare code path;
+* **module-attribute existence** (VERDICT r4 #8): ``mod.foo`` reads
+  where ``mod`` is a package-internal module and ``foo`` is defined
+  nowhere in it (functions, classes, module-level assigns, re-exports);
+* **subscript-key typos** (VERDICT r4 #8): ``obj["metadta"]`` — a
+  string subscript key used once package-wide at edit distance 1 from
+  a key used ≥10 times (``"metadata"``).  Self-calibrating from the
+  package's own key vocabulary, so no hardcoded K8s schema;
+* **Optional-return discipline** (VERDICT r4 #8): the result of a call
+  whose return annotation is ``Optional[...]``/``... | None`` used
+  directly — ``f(...)["x"]``, ``f(...).attr``, ``f(...)[...](...)`` —
+  without a None guard.  Resolves plain calls, ``self.method()``, and
+  calls through annotated attributes (``self.client.get(...)`` where
+  ``client: ClusterClient`` — the Protocol surface).
 
 Resolution is deliberately conservative: only names defined in this
 package and resolvable without inference are checked; ``*args`` /
@@ -72,8 +85,11 @@ class FuncSig:
     kwarg: bool = False
     is_method: bool = False  # first arg is self/cls (stripped)
     decorated_opaque: bool = False  # decorator may change the signature
+    is_property: bool = False
     annotations: Dict[str, str] = field(default_factory=dict)
     optional_params: Set[str] = field(default_factory=set)
+    return_ann: str = ""
+    return_optional: bool = False
 
 
 @dataclass
@@ -83,6 +99,11 @@ class ClassInfo:
     bases: List[str] = field(default_factory=list)  # unresolved base names
     methods: Dict[str, FuncSig] = field(default_factory=dict)
     attrs: Set[str] = field(default_factory=set)
+    #: attribute -> simple type name, from class-body/`self.x` AnnAssigns
+    #: and `self.x = <annotated __init__ param>` (the Protocol seam)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    #: attrs whose typed assignments disagree — never resolved
+    attr_type_conflicts: Set[str] = field(default_factory=set)
     dynamic: bool = False  # __getattr__ / setattr / **-splat init etc.
     is_dataclass: bool = False
     external_base: bool = False  # set during resolution
@@ -104,6 +125,8 @@ def _ann_name(node: Optional[ast.AST]) -> Tuple[str, bool]:
     """(simple type name or "", is_optional) for an annotation node."""
     if node is None:
         return "", False
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "None", False  # `None` inside a string annotation
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         try:
             node = ast.parse(node.value, mode="eval").body
@@ -142,6 +165,8 @@ def _sig_from_def(fn: ast.FunctionDef, module: str, in_class: bool) -> FuncSig:
         decorators.add(d)
     if decorators - _SIG_PRESERVING:
         sig.decorated_opaque = True
+    if decorators & {"property", "cached_property"}:
+        sig.is_property = True
     if in_class and "staticmethod" not in decorators and names:
         names = names[1:]  # strip self/cls
     sig.args = names
@@ -165,6 +190,9 @@ def _sig_from_def(fn: ast.FunctionDef, module: str, in_class: bool) -> FuncSig:
             sig.annotations[arg.arg] = name
             if optional:
                 sig.optional_params.add(arg.arg)
+    ret, ret_opt = _ann_name(fn.returns)
+    sig.return_ann = ret
+    sig.return_optional = ret_opt
     return sig
 
 
@@ -177,6 +205,13 @@ class Indexer(ast.NodeVisitor):
         self.classes: Dict[str, ClassInfo] = {}
         #: local name -> (module, original name) for package imports
         self.imports: Dict[str, Tuple[str, str]] = {}
+        #: local name -> relative-import level (0 = absolute)
+        self.import_levels: Dict[str, int] = {}
+        #: module-level assigned names (constants, type aliases, …)
+        self.assigns: Set[str] = set()
+        #: local alias -> dotted module path, for `import a.b [as c]`
+        self.module_aliases: Dict[str, str] = {}
+        self.dynamic_module: bool = False  # module-level __getattr__
         self._class: Optional[ClassInfo] = None
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -184,6 +219,59 @@ class Indexer(ast.NodeVisitor):
             mod = node.module or ""
             for alias in node.names:
                 self.imports[alias.asname or alias.name] = (mod, alias.name)
+                self.import_levels[alias.asname or alias.name] = node.level
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name.startswith(DEFAULT_ROOTS[0]):
+                self.module_aliases[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+            else:
+                # external import (os, json, …): the bound name is a
+                # legitimate module attribute of THIS module
+                self.assigns.add(alias.asname or alias.name.split(".")[0])
+
+    def finish(self, tree: ast.AST) -> None:
+        """Post-pass: every name bound by module-level non-def
+        statements (for/with/walrus/except targets, external
+        from-imports) is a real module attribute — without these the
+        module-attribute existence check false-positives on ordinary
+        code."""
+        for stmt in getattr(tree, "body", []):
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)
+                ):
+                    self.assigns.add(sub.id)
+                elif isinstance(sub, ast.ExceptHandler) and sub.name:
+                    self.assigns.add(sub.name)
+                elif isinstance(sub, ast.ImportFrom):
+                    for alias in sub.names:
+                        bound = alias.asname or alias.name
+                        # package-internal bindings stay ONLY in
+                        # self.imports — putting them in assigns would
+                        # shadow-block module-alias resolution
+                        if bound != "*" and bound not in self.imports:
+                            self.assigns.add(bound)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._class is None:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.assigns.add(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for e in t.elts:
+                        if isinstance(e, ast.Name):
+                            self.assigns.add(e.id)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if self._class is None and isinstance(node.target, ast.Name):
+            self.assigns.add(node.target.id)
 
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
         info = ClassInfo(name=node.name, module=self.module)
@@ -208,6 +296,9 @@ class Indexer(ast.NodeVisitor):
                 stmt.target, ast.Name
             ):
                 info.attrs.add(stmt.target.id)
+                ann, _ = _ann_name(stmt.annotation)
+                if ann:
+                    info.attr_types[stmt.target.id] = ann
             elif isinstance(stmt, ast.Assign):
                 for t in stmt.targets:
                     if isinstance(t, ast.Name):
@@ -216,6 +307,16 @@ class Indexer(ast.NodeVisitor):
         self.classes[node.name] = info
 
     def _collect_self_assigns(self, fn: ast.AST, info: ClassInfo) -> None:
+        # param -> simple annotation name, so `self.client = client`
+        # in an __init__ whose param is `client: ClusterClient` types
+        # the attribute (the Protocol seam managers are built on)
+        param_ann: Dict[str, str] = {}
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for arg in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+                if arg.annotation is not None:
+                    name, opt = _ann_name(arg.annotation)
+                    if name and not opt:
+                        param_ann[arg.arg] = name
         for sub in ast.walk(fn):
             if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
                 targets = (
@@ -236,6 +337,28 @@ class Indexer(ast.NodeVisitor):
                         and t.value.id == "self"
                     ):
                         info.attrs.add(t.attr)
+                        new_type = None
+                        if isinstance(sub, ast.AnnAssign):
+                            ann, opt = _ann_name(sub.annotation)
+                            if ann and not opt:
+                                new_type = ann
+                        elif (
+                            isinstance(sub, ast.Assign)
+                            and isinstance(sub.value, ast.Name)
+                            and sub.value.id in param_ann
+                        ):
+                            new_type = param_ann[sub.value.id]
+                        else:
+                            # untyped assignment anywhere: the static
+                            # type is not trustworthy (order-independent
+                            # — resolution requires typed AND unpoisoned)
+                            info.attr_type_conflicts.add(t.attr)
+                        if new_type is not None:
+                            old = info.attr_types.get(t.attr)
+                            if old is not None and old != new_type:
+                                info.attr_type_conflicts.add(t.attr)
+                            else:
+                                info.attr_types[t.attr] = new_type
             elif isinstance(sub, ast.Call):
                 f, _ = _ann_name(sub.func)
                 if f in ("setattr", "delattr", "vars", "__dict__"):
@@ -251,6 +374,8 @@ class Indexer(ast.NodeVisitor):
             self.functions[node.name] = _sig_from_def(
                 node, self.module, in_class=False
             )
+            if node.name == "__getattr__":  # PEP 562 dynamic module
+                self.dynamic_module = True
         # do not recurse: nested defs are out of scope
 
 
@@ -277,13 +402,19 @@ class Checker(ast.NodeVisitor):
         path: str,
         index: Dict[str, "Indexer"],
         problems: List[str],
+        key_suspects: Optional[Dict[str, str]] = None,
     ) -> None:
         self.module = module
         self.path = path
         self.index = index
         self.local = index[module]
         self.problems = problems
+        #: suspicious subscript key -> the common key it is 1 edit from
+        self.key_suspects = key_suspects or {}
         self._class_stack: List[ClassInfo] = []
+        #: per-enclosing-function sets of locally bound names, so a
+        #: local `client = ...` never resolves as a module alias
+        self._scope_stack: List[Set[str]] = []
 
     # ------------------------------------------------------------ resolve
     def _resolve_call(self, func: ast.AST) -> Optional[FuncSig]:
@@ -304,7 +435,89 @@ class Checker(ast.NodeVisitor):
                 return self._resolve_method(
                     self._class_stack[-1], func.attr
                 )
+            # mod.func(...) through a package-internal module alias
+            idx = self._module_for_alias(func.value.id)
+            if idx is not None:
+                if func.attr in idx.functions:
+                    return idx.functions[func.attr]
+                if func.attr in idx.classes:
+                    return self._init_sig(idx.classes[func.attr])
+            return None
+        # self.<attr>.<method>(...) where the attr's type is a package
+        # class/Protocol (the ClusterClient seam — VERDICT r4 #8)
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Attribute)
+            and isinstance(func.value.value, ast.Name)
+            and func.value.value.id == "self"
+            and self._class_stack
+        ):
+            cls = self._class_stack[-1]
+            attr = func.value.attr
+            if attr in cls.attr_type_conflicts:
+                return None
+            tname = cls.attr_types.get(attr)
+            if not tname:
+                return None
+            target = self._find_class(self.module, tname)
+            if target is None:
+                return None
+            sig = self._resolve_method(target, func.attr)
+            if sig is not None and (sig.is_property or sig.decorated_opaque):
+                return None
+            return sig
         return None
+
+    def _locals_of(self, fn: ast.AST) -> Set[str]:
+        bound: Set[str] = set()
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = fn.args
+            for arg in a.posonlyargs + a.args + a.kwonlyargs:
+                bound.add(arg.arg)
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Name) and isinstance(
+                sub.ctx, (ast.Store, ast.Del)
+            ):
+                bound.add(sub.id)
+            elif isinstance(sub, ast.ExceptHandler) and sub.name:
+                bound.add(sub.name)
+        return bound
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._scope_stack.append(self._locals_of(node))
+        self.generic_visit(node)
+        self._scope_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _module_for_alias(self, name: str) -> Optional["Indexer"]:
+        """The Indexer of the package-internal module bound to *name*
+        in this module's namespace, or None.  Locally rebound names
+        never resolve (a `client = ...` local shadows a module)."""
+        if any(name in scope for scope in self._scope_stack):
+            return None
+        if name in self.local.assigns:  # module-level rebinding
+            return None
+        path = self.local.module_aliases.get(name)
+        if path is not None:
+            return self.index.get(path)
+        if name not in self.local.imports:
+            return None
+        mod, orig = self.local.imports[name]
+        level = self.local.import_levels.get(name, 0)
+        if level:
+            parts = self.module.split(".")
+            if level > len(parts):
+                return None
+            prefix = ".".join(parts[: len(parts) - level])
+            candidate = ".".join(x for x in (prefix, mod, orig) if x)
+        else:
+            candidate = f"{mod}.{orig}" if mod else orig
+        return self.index.get(candidate)
 
     def _lookup(self, module_hint: str, name: str) -> Optional[FuncSig]:
         for mod, idx in self.index.items():
@@ -503,6 +716,70 @@ class Checker(ast.NodeVisitor):
                 f"parameter {param!r}: {ann} ({sig.module}:{sig.lineno})",
             )
 
+    # ------------------------------------------------- VERDICT r4 #8 checks
+    def _check_optional_use(self, value: ast.AST, how: str, node: ast.AST) -> None:
+        """*value* is the receiver of a subscript/attribute access; if
+        it is a call returning Optional, that access needs a guard."""
+        if not isinstance(value, ast.Call):
+            return
+        sig = self._resolve_call(value.func)
+        if sig is None or sig.decorated_opaque or not sig.return_optional:
+            return
+        self._report(
+            node,
+            f"result of {sig.name}() is Optional[{sig.return_ann or '...'}] "
+            f"but is {how} without a None guard "
+            f"({sig.module}:{sig.lineno})",
+        )
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        self.generic_visit(node)
+        if isinstance(node.ctx, ast.Load):
+            self._check_optional_use(node.value, "subscripted", node)
+        key = (
+            node.slice.value
+            if isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)
+            else None
+        )
+        if key is not None and key in self.key_suspects:
+            self._report(
+                node,
+                f"subscript key {key!r} is used once package-wide and is "
+                f"one edit from {self.key_suspects[key]!r} — typo?",
+            )
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+        if not isinstance(node.ctx, ast.Load):
+            return
+        self._check_optional_use(node.value, f"read (.{node.attr})", node)
+        # mod.attr existence for package-internal module aliases
+        if isinstance(node.value, ast.Name):
+            idx = self._module_for_alias(node.value.id)
+            if idx is None or idx.dynamic_module:
+                return
+            known = (
+                set(idx.functions)
+                | set(idx.classes)
+                | idx.assigns
+                | set(idx.imports)
+                | set(idx.module_aliases)
+            )
+            # submodules of a package count (pkg.sub after import pkg.sub)
+            prefix = idx.module + "."
+            known |= {
+                m[len(prefix):].split(".")[0]
+                for m in self.index
+                if m.startswith(prefix)
+            }
+            if node.attr not in known and not node.attr.startswith("__"):
+                self._report(
+                    node,
+                    f"module {idx.module} has no attribute "
+                    f"{node.attr!r}",
+                )
+
     def _check_self_reads(self, node: ast.ClassDef, info: ClassInfo) -> None:
         resolved = self._mro(info)
         if resolved is None or any(c.dynamic for c in resolved):
@@ -541,6 +818,57 @@ class Checker(ast.NodeVisitor):
         )
 
 
+def _one_edit_apart(a: str, b: str) -> bool:
+    """Levenshtein distance 1, plus adjacent transposition (Damerau)."""
+    if a == b:
+        return False
+    la, lb = len(a), len(b)
+    if abs(la - lb) > 1:
+        return False
+    if la == lb:
+        diffs = [i for i in range(la) if a[i] != b[i]]
+        if len(diffs) == 1:
+            return True
+        return (
+            len(diffs) == 2
+            and diffs[1] == diffs[0] + 1
+            and a[diffs[0]] == b[diffs[1]]
+            and a[diffs[1]] == b[diffs[0]]
+        )
+    if la > lb:
+        a, b, la, lb = b, a, lb, la
+    # b is a with one insertion
+    i = 0
+    while i < la and a[i] == b[i]:
+        i += 1
+    return a[i:] == b[i + 1:]
+
+
+def _key_suspects(trees: Dict[str, ast.AST]) -> Dict[str, str]:
+    """rare key -> common neighbor: string subscript keys used once
+    package-wide sitting one edit from a key used >= 10 times."""
+    counts: Dict[str, int] = {}
+    for tree in trees.values():
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)
+            ):
+                key = node.slice.value
+                counts[key] = counts.get(key, 0) + 1
+    common = [k for k, n in counts.items() if n >= 10]
+    out: Dict[str, str] = {}
+    for key, n in counts.items():
+        if n > 1 or len(key) < 4:
+            continue
+        for c in common:
+            if _one_edit_apart(key, c):
+                out[key] = c
+                break
+    return out
+
+
 def check_paths(roots: List[str]) -> List[str]:
     files: List[Tuple[str, str]] = []  # (path, module)
     for root in roots:
@@ -564,11 +892,13 @@ def check_paths(roots: List[str]) -> List[str]:
             tree = ast.parse(fh.read(), filename=path)
         idx = Indexer(module)
         idx.visit(tree)
+        idx.finish(tree)
         index[module] = idx
         trees[module] = tree
     problems: List[str] = []
+    suspects = _key_suspects(trees)
     for path, module in files:
-        Checker(module, path, index, problems).visit(trees[module])
+        Checker(module, path, index, problems, suspects).visit(trees[module])
     return problems
 
 

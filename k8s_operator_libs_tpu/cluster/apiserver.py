@@ -44,7 +44,7 @@ import logging
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
 from .client import KindInfo, route_for_path
@@ -192,6 +192,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._drain_body()
             self._check_auth()
             (info, namespace, name, subresource), query = self._route()
+            # Fault-injection seam (ApiServerFacade.with_faults): runs
+            # AFTER routing/auth and BEFORE handling, so a test can
+            # mutate the store between two pages of one paginated LIST
+            # (forcing a 410 on the continue token) or fail specific
+            # requests.  An ApiError raised here is served as a normal
+            # error Status — exactly what a real apiserver interposes.
+            hook = getattr(self, "request_hook", None)
+            if hook is not None:
+                hook(method, info, namespace, name, query)
             # Priority-and-fairness max-in-flight: a real apiserver sheds
             # load with 429 + Retry-After + the flow-schema header BEFORE
             # processing.  Long-held watch streams are exempt (APF seats
@@ -445,10 +454,19 @@ class _Handler(BaseHTTPRequestHandler):
         # events matched our kind (waiting on `position`, which only moves
         # on matching events, would busy-spin through foreign-kind churn).
         cursor = position
+        # Fault injection (ApiServerFacade.with_faults): abruptly reset
+        # the connection after this many event frames — the LB-idle-cut
+        # / network-flap a production informer must absorb mid-hold.
+        max_frames = getattr(self, "held_stream_max_frames", 0)
+        frames_written = 0
         try:
             if initial_frames:
                 self.wfile.write(("\n".join(initial_frames) + "\n").encode())
                 self.wfile.flush()
+                frames_written += len(initial_frames)
+                if max_frames and frames_written >= max_frames:
+                    self._flap_held_stream()
+                    return
             while True:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -478,6 +496,10 @@ class _Handler(BaseHTTPRequestHandler):
                     if frames:
                         self.wfile.write(("\n".join(frames) + "\n").encode())
                         self.wfile.flush()
+                        frames_written += len(frames)
+                        if max_frames and frames_written >= max_frames:
+                            self._flap_held_stream()
+                            return
             if bookmarks:
                 self.wfile.write(
                     (
@@ -488,6 +510,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass  # client went away mid-stream
+
+    def _flap_held_stream(self) -> None:
+        """Abruptly reset a held watch connection (with_faults): no
+        closing bookmark, no clean FIN — the client's next read fails
+        and its reconnect logic must resume from its own position."""
+        counters = getattr(self, "fault_counters", None)
+        if counters is not None:
+            counters["held_flaps"] = counters.get("held_flaps", 0) + 1
+        try:
+            import socket as _socket
+
+            self.connection.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
 
     def _handle_post(self, info, namespace, name, subresource, query) -> None:
         body = self._read_body()
@@ -595,12 +631,16 @@ class ApiServerFacade:
             "rejected": 0,
             "served": 0,
         }
+        #: Shared fault-injection counters (with_faults observability):
+        #: ``held_flaps`` counts abrupt held-stream resets served.
+        self.fault_counters: Dict[str, int] = {"held_flaps": 0}
         self._handler_cls = type(
             "BoundHandler",
             (_Handler,),
             {
                 "cluster": cluster,
                 "accepted_tokens": accepted_tokens,
+                "fault_counters": self.fault_counters,
                 # >0: server-enforced page cap — every LIST paginates at
                 # most this many items per response, client limit or not
                 # (how the contract tests force the pager onto every
@@ -630,6 +670,26 @@ class ApiServerFacade:
 
         self._handler_cls.chaos_drop_ratio = drop_ratio
         self._handler_cls.chaos_rng = _random.Random(seed)
+        return self
+
+    def with_faults(
+        self,
+        request_hook=None,
+        held_stream_max_frames: int = 0,
+    ) -> "ApiServerFacade":
+        """Deterministic fault injection (beyond with_chaos's random
+        drops).  *request_hook(method, info, namespace, name, query)*
+        runs after routing/auth and before handling on every request —
+        mutate the store between two pages of a paginated LIST to
+        expire a continue token, or raise an ApiError to fail chosen
+        requests.  *held_stream_max_frames* > 0 abruptly resets every
+        held watch stream after that many event frames (counted in
+        :data:`fault_counters`) — the mid-hold network flap.
+        Chainable; call with defaults to disable."""
+        self._handler_cls.request_hook = (
+            staticmethod(request_hook) if request_hook is not None else None
+        )
+        self._handler_cls.held_stream_max_frames = held_stream_max_frames
         return self
 
     @property

@@ -49,6 +49,74 @@ def detect_tpu() -> Optional[Dict[str, Any]]:
     }
 
 
+#: Public per-chip peak dense bf16 TFLOP/s (cloud.google.com/tpu/docs
+#: system-architecture tables), keyed by jax device_kind.  Used for the
+#: MFU estimate; unknown kinds simply omit it.
+_PEAK_BF16_TFLOPS = {
+    "TPU v3": 123.0,
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def _train_flops_per_step(config, params, batch_size: int) -> float:
+    """Scaling-book train-step FLOPs estimate: 6·P per token for the
+    matmul stack (fwd 2·P, bwd 4·P) plus the attention score/weight
+    terms 12·L·S²·D per sequence (fwd+bwd, causal halving ignored —
+    the convention MFU tables use)."""
+    import jax
+
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    tokens = batch_size * config.max_seq_len
+    dense = 6.0 * n_params * tokens
+    attn = (
+        12.0
+        * config.n_layers
+        * batch_size
+        * config.max_seq_len**2
+        * config.d_model
+    )
+    return dense + attn
+
+
+def _matmul_bench(iters: int = 30) -> Dict[str, Any]:
+    """Pure-MXU floor: one large bf16 matmul, timed.  The cheapest
+    possible silicon number (~seconds of device time after import), so
+    the STAGED capture (hack/tpu_stage.py) can bank evidence that the
+    chip computes before attempting anything heavier — a tunnel that
+    wedges mid-round then costs the later stages, not this one."""
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    # 4096³ bf16 ≈ 137 GFLOP/call — sub-ms on any TPU, but seconds per
+    # call on CPU, where 1024³ keeps the stage inside its timeout.
+    n = 4096 if platform == "tpu" else 1024
+    dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
+    key = jax.random.PRNGKey(0)
+    ka, kb = jax.random.split(key)
+    a = jax.random.normal(ka, (n, n), dtype)
+    b = jax.random.normal(kb, (n, n), dtype)
+    f = jax.jit(lambda x, y: x @ y)
+    f(a, b).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = f(a, b)
+    r.block_until_ready()
+    elapsed = time.perf_counter() - t0
+    tflops = 2 * n**3 * iters / elapsed / 1e12
+    return {
+        "n": n,
+        "dtype": str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
+        "ms_per_matmul": round(elapsed / iters * 1e3, 3),
+        "tflops": round(tflops, 1),
+    }
+
+
 def _attention_bench(iters: int = 30) -> Dict[str, Any]:
     """Compiled Pallas flash kernel vs XLA dense attention on the chip
     (bf16, head_dim 64) — the per-chip hot-op number the framework's
@@ -218,6 +286,7 @@ def run_smoke(
     batch_size: int = 8,
     config=None,
     drain: bool = True,
+    kernel_sections: bool = True,
 ) -> Dict[str, Any]:
     """Train, time, drain-checkpoint, resume; returns the measurement
     dict (see module docstring).  *checkpoint_dir* must be an absolute
@@ -294,7 +363,20 @@ def run_smoke(
         },
         "final_loss": round(float(loss), 4),
     }
-    if platform == "tpu":
+    # MFU estimate (VERDICT r4 next #1 done-bar): model FLOPs per step
+    # over measured step time, against the chip's public bf16 peak.
+    flops = _train_flops_per_step(config, trainer.params, batch_size)
+    achieved_tflops = flops / (step_ms / 1e3) / 1e12
+    result["model"]["params"] = sum(
+        x.size for x in jax.tree_util.tree_leaves(trainer.params)
+    )
+    result["achieved_tflops"] = round(achieved_tflops, 2)
+    peak = _PEAK_BF16_TFLOPS.get(result["device_kind"])
+    if platform == "tpu" and peak:
+        result["mfu_pct"] = round(100.0 * achieved_tflops / peak, 2)
+    if not kernel_sections:
+        pass  # staged capture times each kernel section separately
+    elif platform == "tpu":
         # additive: a kernel-lowering failure (Mosaic drift on a new TPU
         # generation) must not destroy the step-time measurement above
         try:
@@ -387,3 +469,101 @@ def run_smoke(
         "resumed_loss": round(resumed.losses[-1], 4),
     }
     return result
+
+
+#: The staged-capture vocabulary, cheapest first (hack/tpu_stage.py).
+#: ``touch`` exists to discriminate the tunnel's failure modes: round-5
+#: evidence shows device DISCOVERY answering in 2.5 s while the first
+#: actual computation wedges — one 8×8 matmul is the cheapest possible
+#: compute proof.
+STAGES = ("touch", "matmul", "train", "attention", "decode", "drain")
+
+
+def _touch_bench() -> Dict[str, Any]:
+    """Execute one trivial op on the device and time it end-to-end
+    (dispatch + execute + readback) — proves the compute path moves at
+    all, in ~a second of device time."""
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    a = jnp.ones((8, 8), jnp.float32)
+    r = (a @ a).block_until_ready()
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "first_compute_ms": round(wall_ms, 1),
+        "checksum": float(r.sum()),
+    }
+
+
+def run_stage(
+    stage: str,
+    checkpoint_dir: Optional[str] = None,
+    steps: int = 10,
+    batch_size: int = 8,
+) -> Dict[str, Any]:
+    """One isolated measurement stage, for the staged silicon capture
+    (VERDICT r4 next #1, hardened after the r5 wedge-mid-measure: the
+    monolithic run_smoke forfeits EVERYTHING when the tunnel wedges at
+    minute 12; each stage here runs in its own subprocess with its own
+    timeout and is persisted the moment it lands).  Every record is
+    stamped with the real platform — a CPU run can never masquerade as
+    silicon."""
+    import tempfile
+
+    import jax
+
+    if stage not in STAGES:
+        raise ValueError(f"unknown stage {stage!r}; want one of {STAGES}")
+    dev = jax.devices()[0]
+    stamp = {"platform": dev.platform, "device_kind": dev.device_kind}
+    if stage == "touch":
+        return {**stamp, "touch": _touch_bench()}
+    if stage == "matmul":
+        return {**stamp, "matmul": _matmul_bench()}
+    if stage == "attention":
+        return {**stamp, "attention_kernel": _attention_bench()}
+    if stage == "decode":
+        from .workload import CheckpointingTrainer, ModelConfig
+
+        import jax.numpy as jnp
+
+        config = ModelConfig(
+            vocab_size=2048,
+            d_model=512,
+            n_heads=8,
+            n_layers=4,
+            d_ff=2048,
+            max_seq_len=256,
+            dtype=jnp.bfloat16 if dev.platform == "tpu" else jnp.float32,
+        )
+        with tempfile.TemporaryDirectory(prefix="tpu-stage-") as tmp:
+            trainer = CheckpointingTrainer(
+                config, tmp, watcher=None, batch_size=batch_size
+            )
+            new_tokens = 0 if dev.platform == "tpu" else 32
+            return {
+                **stamp,
+                "decode": _decode_bench(
+                    config, trainer.params, new_tokens=new_tokens
+                ),
+            }
+    # train / drain share run_smoke minus the kernel sections
+    with tempfile.TemporaryDirectory(prefix="tpu-stage-") as tmp:
+        ckpt = checkpoint_dir or tmp
+        if stage == "train":
+            return run_smoke(
+                ckpt,
+                steps=steps,
+                batch_size=batch_size,
+                drain=False,
+                kernel_sections=False,
+            )
+        rec = run_smoke(
+            ckpt,
+            steps=2,
+            batch_size=batch_size,
+            drain=True,
+            kernel_sections=False,
+        )
+        return {**stamp, "drain_handshake": rec["drain_handshake"]}

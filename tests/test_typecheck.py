@@ -296,3 +296,315 @@ class TestDataclassDefaults:
                 tags: list = field(default_factory=list)
             """,
         ) == []
+
+
+def run_on_files(tmp_path, **files):
+    """Multi-module package fixture: pkg/<name>.py per kwarg."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name, source in files.items():
+        (pkg / f"{name}.py").write_text(textwrap.dedent(source))
+    return check_paths([str(pkg)])
+
+
+class TestSubscriptKeyTypos:
+    """VERDICT r4 #8 acceptance: an injected node["metadta"] fails lint."""
+
+    COMMON = "obj = {}\n" + "\n".join(
+        f"x{i} = obj['metadata']" for i in range(12)
+    )
+
+    def test_one_edit_typo_caught(self, tmp_path):
+        problems = run_on(tmp_path, self.COMMON + '\ny = obj["metadta"]\n')
+        assert any("'metadta'" in p and "typo" in p for p in problems)
+
+    def test_distant_rare_key_quiet(self, tmp_path):
+        assert run_on(
+            tmp_path, self.COMMON + '\ny = obj["nodeSelector"]\n'
+        ) == []
+
+    def test_repeated_key_is_vocabulary_not_typo(self, tmp_path):
+        # a key used more than once is treated as deliberate
+        assert run_on(
+            tmp_path,
+            self.COMMON + '\ny = obj["metadta"]\nz = obj["metadta"]\n',
+        ) == []
+
+
+class TestModuleAttributeExistence:
+    def test_missing_module_attr_caught(self, tmp_path):
+        problems = run_on_files(
+            tmp_path,
+            util="""
+            def helper(a):
+                return a
+            """,
+            mod="""
+            from . import util
+
+            def go():
+                return util.helperr(1)
+            """,
+        )
+        assert any("no attribute 'helperr'" in p for p in problems)
+
+    def test_functions_classes_assigns_reexports_known(self, tmp_path):
+        assert run_on_files(
+            tmp_path,
+            base="""
+            LIMIT = 10
+
+            class Thing:
+                pass
+
+            def helper(a):
+                return a
+            """,
+            util="""
+            from .base import Thing
+            """,
+            mod="""
+            from . import base, util
+
+            def go():
+                return base.helper(base.LIMIT), base.Thing(), util.Thing
+            """,
+        ) == []
+
+    def test_local_shadowing_never_resolves_as_module(self, tmp_path):
+        assert run_on_files(
+            tmp_path,
+            util="""
+            def helper(a):
+                return a
+            """,
+            mod="""
+            from . import util
+
+            def go(util):
+                return util.anything(1)
+
+            def go2():
+                util = object()
+                return util.whatever
+            """,
+        ) == []
+
+    def test_dynamic_module_skipped(self, tmp_path):
+        assert run_on_files(
+            tmp_path,
+            util="""
+            def __getattr__(name):
+                return 42
+            """,
+            mod="""
+            from . import util
+
+            def go():
+                return util.lazy_thing
+            """,
+        ) == []
+
+
+class TestOptionalReturnDiscipline:
+    """VERDICT r4 #8 acceptance: a None-returning call used unguarded
+    fails lint."""
+
+    def test_optional_subscript_caught(self, tmp_path):
+        problems = run_on(
+            tmp_path,
+            """
+            from typing import Optional
+
+            def find(x) -> Optional[dict]:
+                return None
+
+            def go():
+                return find(1)["spec"]
+            """,
+        )
+        assert any("Optional" in p and "subscripted" in p for p in problems)
+
+    def test_optional_attr_read_caught(self, tmp_path):
+        problems = run_on(
+            tmp_path,
+            """
+            def find(x) -> "dict | None":
+                return None
+
+            def go():
+                return find(1).items()
+            """,
+        )
+        assert any("Optional" in p and ".items" in p for p in problems)
+
+    def test_guarded_use_quiet(self, tmp_path):
+        assert run_on(
+            tmp_path,
+            """
+            from typing import Optional
+
+            def find(x) -> Optional[dict]:
+                return None
+
+            def go():
+                hit = find(1)
+                if hit is None:
+                    return None
+                return hit["spec"]
+
+            def go2():
+                return (find(1) or {}).get("spec")
+            """,
+        ) == []
+
+    def test_non_optional_return_quiet(self, tmp_path):
+        assert run_on(
+            tmp_path,
+            """
+            def find(x) -> dict:
+                return {}
+
+            def go():
+                return find(1)["spec"]
+            """,
+        ) == []
+
+
+class TestProtocolSurfaceCalls:
+    """self.client.<method>() resolved via the annotated __init__ param
+    — the ClusterClient seam (VERDICT r4 #8)."""
+
+    CLIENT = """
+    from typing import Optional, Protocol
+
+    class ClusterClient(Protocol):
+        def get(self, kind: str, name: str) -> dict: ...
+
+        def find(self, kind: str, name: str) -> Optional[dict]: ...
+    """
+
+    def test_arity_checked_through_typed_attr(self, tmp_path):
+        problems = run_on_files(
+            tmp_path,
+            client=self.CLIENT,
+            mgr="""
+            from .client import ClusterClient
+
+            class Mgr:
+                def __init__(self, client: ClusterClient):
+                    self.client = client
+
+                def go(self):
+                    return self.client.get("Node", "n1", "extra")
+            """,
+        )
+        assert any("3 positional args" in p for p in problems)
+
+    def test_optional_protocol_result_guarded(self, tmp_path):
+        problems = run_on_files(
+            tmp_path,
+            client=self.CLIENT,
+            mgr="""
+            from .client import ClusterClient
+
+            class Mgr:
+                def __init__(self, client: ClusterClient):
+                    self.client = client
+
+                def go(self):
+                    return self.client.find("Node", "n1")["metadata"]
+            """,
+        )
+        assert any("Optional" in p and "subscripted" in p for p in problems)
+
+    def test_untyped_reassignment_poisons_attr_type(self, tmp_path):
+        assert run_on_files(
+            tmp_path,
+            client=self.CLIENT,
+            mgr="""
+            from .client import ClusterClient
+
+            def wrap(c):
+                return c
+
+            class Mgr:
+                def __init__(self, client: ClusterClient):
+                    self.client = client
+                    self.client = wrap(client)
+
+                def go(self):
+                    return self.client.get("Node", "n1", "whatever", 4)
+            """,
+        ) == []
+
+    def test_clean_protocol_call_quiet(self, tmp_path):
+        assert run_on_files(
+            tmp_path,
+            client=self.CLIENT,
+            mgr="""
+            from .client import ClusterClient
+
+            class Mgr:
+                def __init__(self, client: ClusterClient):
+                    self.client = client
+
+                def go(self):
+                    return self.client.get("Node", "n1")["metadata"]
+            """,
+        ) == []
+
+
+class TestModuleAttrFalsePositives:
+    """Review regression: names bound by external imports, module-level
+    for/with/walrus targets, and except aliases are legal module
+    attributes — the existence check must know them."""
+
+    def test_external_imports_and_loop_targets_known(self, tmp_path):
+        assert run_on_files(
+            tmp_path,
+            util="""
+            import os
+            import os.path as osp
+            from json import dumps as j
+
+            for key in ("a", "b"):
+                pass
+
+            with open(os.devnull) as fh:
+                pass
+
+            if (flag := True):
+                pass
+
+            try:
+                pass
+            except Exception as caught:
+                caught = caught
+            """,
+            mod="""
+            from . import util
+
+            def go():
+                return (util.os, util.osp, util.j, util.key, util.fh,
+                        util.flag, util.caught)
+            """,
+        ) == []
+
+    def test_internal_module_alias_still_resolves(self, tmp_path):
+        # the fix must not shadow-block package-internal module aliases
+        problems = run_on_files(
+            tmp_path,
+            util="""
+            def helper(a):
+                return a
+            """,
+            mod="""
+            from . import util
+
+            def go():
+                return util.helperr(1)
+            """,
+        )
+        assert any("no attribute 'helperr'" in p for p in problems)
