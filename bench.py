@@ -412,14 +412,19 @@ def tpu_section() -> dict:
             f"tpu smoke timed out after {timeout_s:.0f}s "
             "(tunnel wedged between probe and measure)"
         )
+    elif rec is not None and rec.get("skipped"):
+        # BEFORE the exit-code check: tpu_stage exits 1 by design when
+        # nothing banked, but still prints a structured record whose
+        # reason + per-stage statuses beat a raw stderr tail
+        live_failure = rec.get("reason", "smoke skipped")
+        if rec.get("stages"):
+            live_failure += f" (stages: {rec['stages']})"
     elif res["status"] == "exit":
         live_failure = (
             f"tpu smoke exited {res['returncode']}: {res['stderr_tail']}"
         )
     elif rec is None:
         live_failure = "tpu smoke produced no JSON record"
-    elif rec.get("skipped"):
-        live_failure = rec.get("reason", "smoke skipped")
     else:
         # persist the capture BEFORE decorating the returned copy: the
         # cache must hold only the measurement, or this round's

@@ -135,6 +135,23 @@ class Monitor:
         mon.free_tool_id(self.tool_id)
 
 
+def _ranges(lines: list[int]) -> str:
+    """[3,4,5,9] -> "3-5,9" — the coverage.py missing-lines notation."""
+    out = []
+    start = prev = None
+    for n in lines:
+        if start is None:
+            start = prev = n
+        elif n == prev + 1:
+            prev = n
+        else:
+            out.append(f"{start}-{prev}" if prev > start else str(start))
+            start = prev = n
+    if start is not None:
+        out.append(f"{start}-{prev}" if prev > start else str(start))
+    return ",".join(out)
+
+
 def report(
     targets: dict[str, set[int]],
     executed: dict[str, set[int]],
@@ -144,18 +161,20 @@ def report(
     total_exec = 0
     total_hit = 0
     for path, lines in sorted(targets.items()):
-        hit = len(lines & executed.get(path, set()))
+        hit_set = lines & executed.get(path, set())
+        hit = len(hit_set)
         total_exec += len(lines)
         total_hit += hit
         pct = 100.0 * hit / len(lines) if lines else 100.0
-        rows.append(
-            {
-                "file": os.path.relpath(path, REPO_ROOT),
-                "lines": len(lines),
-                "covered": hit,
-                "pct": round(pct, 1),
-            }
-        )
+        row = {
+            "file": os.path.relpath(path, REPO_ROOT),
+            "lines": len(lines),
+            "covered": hit,
+            "pct": round(pct, 1),
+        }
+        if hit < len(lines):
+            row["missing"] = _ranges(sorted(lines - hit_set))
+        rows.append(row)
     total_pct = 100.0 * total_hit / total_exec if total_exec else 100.0
     rows.sort(key=lambda r: r["pct"])
     print(f"\ncoverage: {total_hit}/{total_exec} lines = {total_pct:.1f}%")

@@ -69,6 +69,8 @@ _TOP_LEVEL = (
     "tokens_per_s",
     "model",
     "final_loss",
+    "achieved_tflops",
+    "mfu_pct",
     "matmul",
     "attention_kernel",
     "decode",

@@ -212,6 +212,9 @@ class Indexer(ast.NodeVisitor):
         #: local alias -> dotted module path, for `import a.b [as c]`
         self.module_aliases: Dict[str, str] = {}
         self.dynamic_module: bool = False  # module-level __getattr__
+        #: True for __init__.py: relative imports resolve against the
+        #: package ITSELF, one level shallower than for plain modules
+        self.is_package: bool = False
         self._class: Optional[ClassInfo] = None
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -510,10 +513,14 @@ class Checker(ast.NodeVisitor):
         mod, orig = self.local.imports[name]
         level = self.local.import_levels.get(name, 0)
         if level:
+            # Python semantics: level 1 = the containing package, which
+            # for __init__.py is the module itself (one component less
+            # to drop than for a plain module)
             parts = self.module.split(".")
-            if level > len(parts):
+            drop = level - 1 if self.local.is_package else level
+            if drop > len(parts):
                 return None
-            prefix = ".".join(parts[: len(parts) - level])
+            prefix = ".".join(parts[: len(parts) - drop])
             candidate = ".".join(x for x in (prefix, mod, orig) if x)
         else:
             candidate = f"{mod}.{orig}" if mod else orig
@@ -891,6 +898,7 @@ def check_paths(roots: List[str]) -> List[str]:
         with open(path, "r", encoding="utf-8") as fh:
             tree = ast.parse(fh.read(), filename=path)
         idx = Indexer(module)
+        idx.is_package = os.path.basename(path) == "__init__.py"
         idx.visit(tree)
         idx.finish(tree)
         index[module] = idx

@@ -370,7 +370,9 @@ def run_smoke(
     result["model"]["params"] = sum(
         x.size for x in jax.tree_util.tree_leaves(trainer.params)
     )
-    result["achieved_tflops"] = round(achieved_tflops, 2)
+    # significant figures, not decimal places: a CI-sized model on CPU
+    # achieves ~1e-5 TFLOPs and must not round to a dead 0.0
+    result["achieved_tflops"] = float(f"{achieved_tflops:.3g}")
     peak = _PEAK_BF16_TFLOPS.get(result["device_kind"])
     if platform == "tpu" and peak:
         result["mfu_pct"] = round(100.0 * achieved_tflops / peak, 2)

@@ -234,3 +234,71 @@ class TestSchemaHelpers:
         del crd["spec"]["versions"][0]["schema"]
         store.update(crd)
         store.create(policy_cr({"maxParallelUpgrades": "three"}))
+
+
+class TestSchemaHelperEdges:
+    """Branch coverage for the pure helpers: version selection in
+    extract_crd_schema and the numeric/string/array bound validators
+    (the envtest-parity admission rules consumers rely on)."""
+
+    def test_extract_prefers_storage_version(self):
+        crd = {
+            "spec": {
+                "names": {"kind": "Widget"},
+                "versions": [
+                    {"name": "v1alpha1", "served": True, "storage": False,
+                     "schema": {"openAPIV3Schema": {"type": "object"}}},
+                    {"name": "v1", "served": True, "storage": True,
+                     "schema": {"openAPIV3Schema": {
+                         "type": "object",
+                         "properties": {"spec": {"type": "object"}}}}},
+                ],
+            }
+        }
+        out = extract_crd_schema(crd)
+        assert out is not None
+        kind, schema = out[0], out[1]
+        assert kind == "Widget"
+        assert "properties" in schema
+
+    def test_extract_falls_back_to_served(self):
+        crd = {
+            "spec": {
+                "names": {"kind": "Widget"},
+                "versions": [
+                    {"name": "v1beta1", "served": True,
+                     "schema": {"openAPIV3Schema": {"type": "object"}}},
+                ],
+            }
+        }
+        assert extract_crd_schema(crd) is not None
+
+    def test_extract_rejects_kindless_and_versionless(self):
+        assert extract_crd_schema({"spec": {}}) is None
+        assert extract_crd_schema(
+            {"spec": {"names": {"kind": "W"}, "versions": []}}
+        ) is None
+
+    def test_numeric_bounds(self):
+        schema = {"type": "integer", "minimum": 1, "maximum": 5}
+        assert validate(3, schema) == []
+        assert any("below minimum" in e for e in validate(0, schema))
+        assert any("above maximum" in e for e in validate(9, schema))
+
+    def test_string_bounds_and_pattern(self):
+        schema = {
+            "type": "string", "minLength": 2, "maxLength": 4,
+            "pattern": "^ab",
+        }
+        assert validate("abc", schema) == []
+        assert any("minLength" in e for e in validate("a", schema))
+        assert any("maxLength" in e for e in validate("abcde", schema))
+        assert any("pattern" in e for e in validate("zz", schema))
+
+    def test_array_min_items(self):
+        schema = {
+            "type": "array", "minItems": 2,
+            "items": {"type": "integer"},
+        }
+        assert validate([1, 2], schema) == []
+        assert any("minItems" in e for e in validate([1], schema))

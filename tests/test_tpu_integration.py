@@ -1300,3 +1300,129 @@ def test_ragged_prompt_generation_matches_solo_rows():
         wl.generate(
             cfg, params, full, 4, prompt_lens=jnp.asarray([3], jnp.int32)
         )
+
+
+class TestDistributedHelpers:
+    """In-process coverage of tpu/distributed.py (VERDICT r4-era gap:
+    the module's real exercise lives in two-process children the
+    coverage tracer cannot see).  Identity resolution is pure logic;
+    the collectives run single-process over the 8 virtual devices —
+    the same jitted reduction path the multi-host barrier rides."""
+
+    def test_resolve_identity_explicit(self):
+        from k8s_operator_libs_tpu.tpu.distributed import resolve_identity
+
+        addr, num, pid = resolve_identity(
+            {
+                "JAX_COORDINATOR_ADDRESS": "10.0.0.1:1234",
+                "JAX_NUM_PROCESSES": "4",
+                "JAX_PROCESS_ID": "2",
+            }
+        )
+        assert (addr, num, pid) == ("10.0.0.1:1234", 4, 2)
+
+    def test_resolve_identity_statefulset_ordinal(self):
+        from k8s_operator_libs_tpu.tpu.distributed import resolve_identity
+
+        addr, num, pid = resolve_identity(
+            {
+                "JAX_COORDINATOR_ADDRESS": "c:1",
+                "JAX_NUM_PROCESSES": "8",
+                "HOSTNAME": "trainer-5",
+            }
+        )
+        assert pid == 5
+
+    def test_resolve_identity_errors(self):
+        import pytest as _pytest
+
+        from k8s_operator_libs_tpu.tpu.distributed import resolve_identity
+
+        with _pytest.raises(ValueError, match="COORDINATOR"):
+            resolve_identity({})
+        with _pytest.raises(ValueError, match="integer"):
+            resolve_identity(
+                {"JAX_COORDINATOR_ADDRESS": "c:1",
+                 "JAX_NUM_PROCESSES": "many"}
+            )
+        with _pytest.raises(ValueError, match="ordinal"):
+            resolve_identity(
+                {"JAX_COORDINATOR_ADDRESS": "c:1",
+                 "JAX_NUM_PROCESSES": "2",
+                 "HOSTNAME": "no-trailing-number-"}
+            )
+        with _pytest.raises(ValueError, match="world size"):
+            resolve_identity(
+                {"JAX_COORDINATOR_ADDRESS": "c:1",
+                 "JAX_NUM_PROCESSES": "2",
+                 "JAX_PROCESS_ID": "7"}
+            )
+
+    def test_global_mesh_axes_and_validation(self):
+        import pytest as _pytest
+
+        from k8s_operator_libs_tpu.tpu.distributed import global_mesh
+
+        mesh = global_mesh(tp=2)  # 8 devices -> dp=4, tp=2
+        assert mesh.axis_names == ("data", "seq", "model", "expert")
+        assert mesh.devices.shape == (4, 1, 2, 1)
+        with _pytest.raises(ValueError, match="global devices"):
+            global_mesh(dp=3, tp=2)
+
+    def test_host_allreduce_max_single_process(self):
+        from k8s_operator_libs_tpu.tpu.distributed import host_allreduce_max
+
+        assert host_allreduce_max(0.0) == 0.0
+        assert host_allreduce_max(2.0) == 2.0
+        # cached-collective path: second call must reuse the jit
+        assert host_allreduce_max(1.0) == 1.0
+
+    def test_sync_global_devices_single_process(self):
+        from k8s_operator_libs_tpu.tpu.distributed import sync_global_devices
+
+        sync_global_devices("coverage-barrier")  # must simply not hang
+
+
+class TestRunStageCpu:
+    """run_stage (the staged-capture library half) on the CPU backend —
+    every stage the CI environment can execute, platform-labeled."""
+
+    def test_touch_stage(self):
+        from k8s_operator_libs_tpu.tpu.smoke import run_stage
+
+        rec = run_stage("touch")
+        assert rec["platform"] == "cpu"
+        assert rec["touch"]["checksum"] == 512.0
+        assert rec["touch"]["first_compute_ms"] > 0
+
+    def test_matmul_stage(self):
+        from k8s_operator_libs_tpu.tpu.smoke import run_stage
+
+        rec = run_stage("matmul")
+        assert rec["matmul"]["n"] == 1024  # CPU size, not the TPU 4096
+        assert rec["matmul"]["tflops"] > 0
+
+    def test_unknown_stage_rejected(self):
+        import pytest as _pytest
+
+        from k8s_operator_libs_tpu.tpu.smoke import run_stage
+
+        with _pytest.raises(ValueError, match="unknown stage"):
+            run_stage("nonsense")
+
+    def test_train_stage_carries_mfu_fields(self, tmp_path):
+        from k8s_operator_libs_tpu.tpu import workload as wl
+        from k8s_operator_libs_tpu.tpu.smoke import run_smoke
+
+        cfg = wl.ModelConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=32,
+        )
+        rec = run_smoke(
+            str(tmp_path), steps=2, batch_size=2, config=cfg,
+            drain=False, kernel_sections=False,
+        )
+        assert rec["platform"] == "cpu"
+        assert rec["achieved_tflops"] > 0
+        assert rec["model"]["params"] > 0
+        assert "mfu_pct" not in rec  # MFU is silicon-only by design

@@ -1,0 +1,216 @@
+"""hack/tpu_stage.py — the staged silicon capture's decision logic.
+
+The orchestrator exists because the r5 tunnel wedged MID-measure after
+a clean probe (see the module docstring); these specs pin the behaviors
+that make it trustworthy: bank-on-success persistence after EVERY
+stage, post-timeout probe gating, budget trimming, and the
+skipped-record contract when nothing lands.  The subprocess layer is
+stubbed (in-process monkeypatching of run_json_child/probe) so the
+specs are deterministic and jax-free; one real-subprocess CPU run of
+the cheapest stage covers the child path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HACK = os.path.join(REPO, "hack")
+if HACK not in sys.path:
+    sys.path.append(HACK)
+
+import tpu_stage  # noqa: E402
+
+
+@pytest.fixture()
+def orchestrate(monkeypatch, capsys):
+    """Run tpu_stage.main() with scripted child/probe outcomes.
+
+    Returns (run, persisted, probes) where run(argv, script) executes
+    main with *script* = {stage: outcome}; outcome is a dict child
+    record, "timeout", or an Exception to simulate launch errors.
+    """
+    persisted = []
+    probes = []
+
+    def fake_persist(rec):
+        persisted.append(json.loads(json.dumps(rec)))
+        return "/dev/null"
+
+    monkeypatch.setattr(tpu_stage, "persist", fake_persist)
+    monkeypatch.setattr(tpu_stage, "append_log", lambda rec: None)
+
+    class FakeClock:
+        """Advances 100 fake seconds per child run, so budget-trimming
+        logic is testable with instant scripted children."""
+
+        def __init__(self):
+            self.t = 0.0
+
+        def monotonic(self):
+            return self.t
+
+    clock = FakeClock()
+    monkeypatch.setattr(tpu_stage, "time", clock)
+
+    def run(argv, script, probe_ok=False):
+        def fake_probe(timeout_s):
+            probes.append(timeout_s)
+            return {"ok": probe_ok}
+
+        def fake_run_json_child(cmd, timeout_s, env=None):
+            clock.t += 100.0
+            stage = cmd[cmd.index("--child") + 1]
+            outcome = script[stage]
+            if outcome == "timeout":
+                return {"status": "timeout", "record": None,
+                        "stderr_tail": ""}
+            if isinstance(outcome, Exception):
+                return {"status": "launch-error", "record": None,
+                        "error": str(outcome)}
+            return {"status": "ok", "record": outcome, "returncode": 0}
+
+        monkeypatch.setattr(tpu_stage, "probe", fake_probe)
+        monkeypatch.setattr(
+            tpu_stage, "run_json_child", fake_run_json_child
+        )
+        monkeypatch.setattr(sys, "argv", ["tpu_stage.py", *argv])
+        rc = tpu_stage.main()
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        return rc, json.loads(out)
+
+    return run, persisted, probes
+
+
+TOUCH_REC = {"platform": "tpu", "device_kind": "TPU v5 lite",
+             "touch": {"first_compute_ms": 3.0, "checksum": 512.0}}
+MATMUL_REC = {"platform": "tpu", "device_kind": "TPU v5 lite",
+              "matmul": {"n": 4096, "tflops": 150.0}}
+
+
+def test_every_success_banked_immediately(orchestrate):
+    run, persisted, _ = orchestrate
+    rc, record = run(
+        ["--stages", "touch,matmul"],
+        {"touch": TOUCH_REC, "matmul": MATMUL_REC},
+    )
+    assert rc == 0
+    assert record["touch"]["checksum"] == 512.0
+    assert record["matmul"]["tflops"] == 150.0
+    # persist ran after EACH stage, not once at the end — a later wedge
+    # must never cost an already-banked number
+    assert len(persisted) == 2
+    assert "matmul" not in persisted[0]
+    assert persisted[1]["matmul"]["tflops"] == 150.0
+
+
+def test_timeout_then_dead_probe_skips_remaining(orchestrate):
+    run, persisted, probes = orchestrate
+    rc, record = run(
+        ["--stages", "touch,matmul,train"],
+        {"touch": TOUCH_REC, "matmul": "timeout", "train": MATMUL_REC},
+        probe_ok=False,
+    )
+    assert rc == 0  # touch banked
+    assert record["stages"]["matmul"].startswith("timeout")
+    assert record["stages"]["train"].startswith("skipped: tunnel wedged")
+    assert probes  # the post-timeout probe actually ran
+    assert len(persisted) == 1  # only touch
+
+
+def test_timeout_with_live_probe_continues(orchestrate):
+    run, persisted, _ = orchestrate
+    rc, record = run(
+        ["--stages", "touch,matmul,train"],
+        {"touch": "timeout", "matmul": MATMUL_REC,
+         "train": {"platform": "tpu", "device_kind": "TPU v5 lite",
+                   "step_time_ms": 9.0}},
+        probe_ok=True,
+    )
+    assert rc == 0
+    assert record["stages"]["touch"].startswith("timeout")
+    assert record["matmul"]["tflops"] == 150.0
+    assert record["step_time_ms"] == 9.0
+
+
+def test_nothing_banked_is_a_skip_record(orchestrate):
+    run, persisted, _ = orchestrate
+    rc, record = run(
+        ["--stages", "touch,matmul"],
+        {"touch": "timeout", "matmul": "timeout"},
+        probe_ok=True,
+    )
+    assert rc == 1
+    assert record["skipped"] is True
+    assert persisted == []  # a skip record must never poison the cache
+
+
+def test_budget_trims_stages(orchestrate):
+    run, _, _ = orchestrate
+    # each scripted child burns 100 fake seconds; budget 250 fits two
+    # stages, then <60s remain and train must be trimmed untried
+    rc, record = run(
+        ["--stages", "touch,matmul,train", "--timeout", "250"],
+        {"touch": TOUCH_REC, "matmul": MATMUL_REC, "train": MATMUL_REC},
+    )
+    assert rc == 0
+    assert "ok" in record["stages"]["touch"]
+    assert "ok" in record["stages"]["matmul"]
+    assert record["stages"]["train"] == "skipped: budget exhausted"
+
+
+def test_child_skip_record_reported_not_banked(orchestrate):
+    run, persisted, _ = orchestrate
+    rc, record = run(
+        ["--stages", "touch"],
+        {"touch": {"skipped": True, "reason": "no TPU visible"}},
+    )
+    assert rc == 1
+    assert record["stages"]["touch"] == "skipped: no TPU visible"
+    assert persisted == []
+
+
+@pytest.mark.skipif(
+    os.environ.get("SKIP_JAX_SUBPROCESS") == "1",
+    reason="jax subprocess suppressed",
+)
+def test_real_touch_stage_on_cpu():
+    """The child path end-to-end: one real subprocess, CPU backend."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HACK, "tpu_stage.py"),
+         "--allow-cpu", "--no-persist", "--stages", "touch"],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=180,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["platform"] == "cpu"  # labeled honestly
+    assert rec["touch"]["checksum"] == 512.0
+    assert "ok" in rec["stages"]["touch"]
+
+
+def test_mfu_fields_survive_the_merge(orchestrate):
+    """Review regression: the train stage's MFU estimate must reach the
+    banked record — a whitelist miss here silently drops the headline
+    silicon number."""
+    run, persisted, _ = orchestrate
+    rc, record = run(
+        ["--stages", "train"],
+        {"train": {"platform": "tpu", "device_kind": "TPU v5 lite",
+                   "step_time_ms": 9.0, "tokens_per_s": 1000.0,
+                   "achieved_tflops": 55.5, "mfu_pct": 28.2}},
+    )
+    assert rc == 0
+    assert record["achieved_tflops"] == 55.5
+    assert record["mfu_pct"] == 28.2
+    assert persisted[0]["mfu_pct"] == 28.2

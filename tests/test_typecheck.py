@@ -608,3 +608,59 @@ class TestModuleAttrFalsePositives:
             """,
         )
         assert any("no attribute 'helperr'" in p for p in problems)
+
+
+class TestPackageRelativeImports:
+    """Review regression: `from . import x` inside __init__.py resolves
+    against the package ITSELF, not its parent — the off-by-one picked
+    the top-level sibling and mis-checked (or falsely failed) correct
+    code."""
+
+    def _pkg(self, tmp_path, init_body):
+        pkg = tmp_path / "pkg"
+        sub = pkg / "sub"
+        sub.mkdir(parents=True)
+        (pkg / "__init__.py").write_text("")
+        (pkg / "consts.py").write_text("TOP = 1\n")
+        (sub / "consts.py").write_text("SUB_ONLY = 2\n")
+        (sub / "__init__.py").write_text(textwrap.dedent(init_body))
+        return check_paths([str(pkg)])
+
+    def test_init_relative_import_resolves_to_own_package(self, tmp_path):
+        # SUB_ONLY exists only in pkg.sub.consts — correct code passes
+        assert self._pkg(
+            tmp_path,
+            """
+            from . import consts
+
+            X = consts.SUB_ONLY
+            """,
+        ) == []
+
+    def test_init_relative_import_still_catches_typos(self, tmp_path):
+        problems = self._pkg(
+            tmp_path,
+            """
+            from . import consts
+
+            X = consts.MISSING
+            """,
+        )
+        assert any(
+            "pkg.sub.consts has no attribute 'MISSING'" in p
+            for p in problems
+        )
+
+    def test_plain_module_level_one_unchanged(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "consts.py").write_text("TOP = 1\n")
+        (pkg / "mod.py").write_text(
+            "from . import consts\n\n\ndef go():\n    return consts.TOP\n"
+        )
+        (pkg / "bad.py").write_text(
+            "from . import consts\n\n\ndef go():\n    return consts.NOPE\n"
+        )
+        problems = check_paths([str(pkg)])
+        assert len(problems) == 1 and "NOPE" in problems[0]
