@@ -519,3 +519,58 @@ class TestReviewFixes:
         _cleanup_all_plugins()  # what atexit runs
         assert not os.path.exists(cred.client_key_file)
         assert not os.path.exists(cred.client_cert_file)
+
+
+class TestHeldWatch401Refresh:
+    """The HELD-stream half of the 401 story (kubeclient's stream
+    runner): a token rotated server-side while a held watch is the only
+    traffic must force one plugin re-run from the stream thread itself
+    and resume delivering events — no regular request is around to
+    refresh the credential for it."""
+
+    def test_held_stream_refreshes_and_resumes(self, tmp_path):
+        script, cred_file, calls_file = write_plugin(tmp_path)
+        set_credential(cred_file, "t1", expires_in_seconds=3600)
+        store = InMemoryCluster()
+        tokens = {"t1"}
+        with ApiServerFacade(store, accepted_tokens=tokens) as facade:
+            client = KubeApiClient(
+                KubeConfig.load(exec_kubeconfig(tmp_path, script, facade.url)),
+                timeout=10.0,
+            )
+            client.start_held_watches(("Node",), hold_seconds=1.0)
+            try:
+                # stream live: an in-proc store write reaches the queue
+                store.create(make_node("n-before"))
+                assert client.wait_for_held_event(timeout=10.0)
+                before_calls = calls(calls_file)
+                # rotate with NO client request in flight: only the
+                # held stream's next reconnect can notice the 401
+                tokens.add("t2")
+                tokens.discard("t1")
+                set_credential(cred_file, "t2", expires_in_seconds=3600)
+                # hold expiry (~1s) forces a reconnect -> 401 -> the
+                # stream thread re-runs the plugin and comes back; an
+                # event created afterwards must still be delivered
+                deadline = time.monotonic() + 20.0
+                delivered = False
+                while time.monotonic() < deadline and not delivered:
+                    time.sleep(0.5)
+                    store.create(
+                        make_node(f"n-after-{int(time.monotonic()*10)}")
+                    )
+                    if client.wait_for_held_event(timeout=2.0):
+                        events = client.events_since(0, kind=("Node",))
+                        delivered = any(
+                            (e.new or {})
+                            .get("metadata", {})
+                            .get("name", "")
+                            .startswith("n-after")
+                            for e in events
+                        )
+                assert delivered, "held stream never resumed after rotation"
+                assert calls(calls_file) > before_calls, (
+                    "the stream thread never re-ran the exec plugin"
+                )
+            finally:
+                client.stop_held_watches()

@@ -357,3 +357,116 @@ class TestJournalReconstruction:
                 "labels"
             ), key
             assert a.get("data") == b.get("data"), key
+
+
+class TestStrategicMergeDirectiveEdges:
+    """The $patch directive branches the rollout suites never hit:
+    replace-at-map, explicit merge, root-level misuse, malformed
+    delete, and every keyed/atomic list rejection path.  Each is the
+    apiserver's strategic-merge contract (kubectl sends these)."""
+
+    @staticmethod
+    def _sm():
+        from k8s_operator_libs_tpu.cluster.strategicmerge import (
+            strategic_merge,
+        )
+
+        return strategic_merge
+
+    def test_replace_directive_replaces_map_wholesale(self):
+        sm = self._sm()
+        out = sm(
+            {"labels": {"a": "1", "b": "2"}},
+            {"labels": {"$patch": "replace", "c": "3"}},
+            kind="Node",
+        )
+        assert out["labels"] == {"c": "3"}
+
+    def test_explicit_merge_directive_is_default_strategy(self):
+        sm = self._sm()
+        out = sm(
+            {"labels": {"a": "1"}},
+            {"labels": {"$patch": "merge", "b": "2"}},
+            kind="Node",
+        )
+        assert out["labels"] == {"a": "1", "b": "2"}
+
+    def test_root_level_delete_rejected(self):
+        from k8s_operator_libs_tpu.cluster.errors import BadRequestError
+
+        sm = self._sm()
+        with pytest.raises(BadRequestError, match="patch root"):
+            sm({"a": 1}, {"$patch": "delete"}, kind="Node")
+
+    def test_unknown_directive_rejected(self):
+        from k8s_operator_libs_tpu.cluster.errors import BadRequestError
+
+        sm = self._sm()
+        with pytest.raises(BadRequestError, match="not valid here"):
+            sm({}, {"x": {"$patch": "upsert"}}, kind="Node")
+
+    def test_delete_with_extra_keys_rejected(self):
+        from k8s_operator_libs_tpu.cluster.errors import BadRequestError
+
+        sm = self._sm()
+        with pytest.raises(BadRequestError, match="must not carry"):
+            sm(
+                {"m": {"a": 1}},
+                {"m": {"$patch": "delete", "stray": 1}},
+                kind="Node",
+            )
+
+    def test_atomic_list_rejects_directives(self):
+        from k8s_operator_libs_tpu.cluster.errors import BadRequestError
+
+        sm = self._sm()
+        # Node has no merge key registered for this path -> atomic
+        with pytest.raises(BadRequestError, match="atomic"):
+            sm(
+                {"spec": {"things": [1]}},
+                {"spec": {"things": [{"$patch": "delete"}]}},
+                kind="Node",
+            )
+
+    def test_keyed_list_replace_directive(self):
+        sm = self._sm()
+        # Pod spec.containers merges on name; a leading $patch: replace
+        # element swaps the whole list for the remainder
+        out = sm(
+            {"spec": {"containers": [{"name": "a", "image": "x"}]}},
+            {"spec": {"containers": [
+                {"$patch": "replace"},
+                {"name": "b", "image": "y"},
+            ]}},
+            kind="Pod",
+        )
+        assert out["spec"]["containers"] == [{"name": "b", "image": "y"}]
+
+    def test_keyed_list_rejects_non_object_and_unknown_directive(self):
+        from k8s_operator_libs_tpu.cluster.errors import BadRequestError
+
+        sm = self._sm()
+        with pytest.raises(BadRequestError, match="must be"):
+            sm(
+                {"spec": {"containers": []}},
+                {"spec": {"containers": ["not-an-object"]}},
+                kind="Pod",
+            )
+        with pytest.raises(BadRequestError, match="unknown \\$patch"):
+            sm(
+                {"spec": {"containers": []}},
+                {"spec": {"containers": [
+                    {"name": "a", "$patch": "upsert"}]}},
+                kind="Pod",
+            )
+
+    def test_keyed_list_requires_merge_key(self):
+        from k8s_operator_libs_tpu.cluster.errors import BadRequestError
+
+        sm = self._sm()
+        with pytest.raises(BadRequestError, match="missing merge key"):
+            sm(
+                {"spec": {"containers": []}},
+                {"spec": {"containers": [{"image": "x"}]}},
+                kind="Pod",
+            )
