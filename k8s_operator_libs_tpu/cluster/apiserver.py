@@ -604,6 +604,40 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
 
+class _TlsThreadingHTTPServer(ThreadingHTTPServer):
+    """HTTPS serving with the handshake OFF the accept thread.
+
+    Wrapping the *listening* socket would run each TLS handshake inside
+    ``accept()`` on the single serve_forever thread — one peer that
+    connects and never sends a ClientHello wedges the whole facade, and
+    concurrent handshakes serialize.  Instead each accepted connection
+    is wrapped in ITS OWN handler thread (``process_request_thread``
+    runs there, per ThreadingMixIn), under a handshake deadline; a
+    stalled or failed handshake costs that one thread, nothing else —
+    which is also how a real apiserver's per-connection TLS behaves."""
+
+    #: set by ApiServerFacade after construction
+    ssl_context = None
+
+    HANDSHAKE_TIMEOUT_S = 10.0
+
+    def process_request_thread(self, request, client_address):
+        try:
+            request.settimeout(self.HANDSHAKE_TIMEOUT_S)
+            request = self.ssl_context.wrap_socket(
+                request, server_side=True
+            )
+            request.settimeout(None)
+        except (OSError, ConnectionError):
+            # handshake failure/timeout: drop this connection only
+            try:
+                request.close()
+            except OSError:
+                pass
+            return
+        super().process_request_thread(request, client_address)
+
+
 class ApiServerFacade:
     """Lifecycle wrapper: serve an InMemoryCluster on 127.0.0.1:<port>."""
 
@@ -614,7 +648,13 @@ class ApiServerFacade:
         accepted_tokens: Optional[set] = None,
         max_list_page: int = 0,
         max_inflight: int = 0,
+        ssl_context=None,
     ) -> None:
+        """*ssl_context*: an ``ssl.SSLContext`` (``PROTOCOL_TLS_SERVER``)
+        to serve HTTPS — envtest parity (the reference's test apiserver
+        speaks TLS, upgrade_suit_test.go:87-93).  Set
+        ``verify_mode=CERT_REQUIRED`` + ``load_verify_locations`` on it
+        for mTLS client-certificate auth."""
         self.cluster = cluster
         #: Mutable: tests rotate the accepted set mid-run to force 401s
         #: (exec-plugin refresh path).  None = no auth required.
@@ -652,8 +692,16 @@ class ApiServerFacade:
                 "apf_state": self.apf_state,
             },
         )
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), self._handler_cls)
+        server_cls = (
+            _TlsThreadingHTTPServer
+            if ssl_context is not None
+            else ThreadingHTTPServer
+        )
+        self._server = server_cls(("127.0.0.1", port), self._handler_cls)
         self._server.daemon_threads = True
+        self._tls = ssl_context is not None
+        if ssl_context is not None:
+            self._server.ssl_context = ssl_context
         self._thread: Optional[threading.Thread] = None
 
     def with_chaos(self, drop_ratio: float, seed: int = 0) -> "ApiServerFacade":
@@ -695,7 +743,8 @@ class ApiServerFacade:
     @property
     def url(self) -> str:
         host, port = self._server.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     @property
     def requests_served(self) -> int:

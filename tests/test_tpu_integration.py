@@ -1426,3 +1426,32 @@ class TestRunStageCpu:
         assert rec["achieved_tflops"] > 0
         assert rec["model"]["params"] > 0
         assert "mfu_pct" not in rec  # MFU is silicon-only by design
+
+
+class TestDecodeBenchCpu:
+    """_decode_bench in-process on CPU: the serving measurement the
+    compute_cpu bench section runs in an untraced subprocess — covered
+    here so a decode/int8 regression breaks the suite, not just the
+    bench artifact."""
+
+    def test_decode_bench_reports_and_int8_agrees(self, tmp_path):
+        import jax.numpy as jnp
+
+        from k8s_operator_libs_tpu.tpu import workload as wl
+        from k8s_operator_libs_tpu.tpu.smoke import _decode_bench
+
+        cfg = wl.ModelConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=48, dtype=jnp.float32,
+        )
+        trainer = wl.CheckpointingTrainer(
+            cfg, str(tmp_path), watcher=None, batch_size=2
+        )
+        rec = _decode_bench(cfg, trainer.params, new_tokens=8)
+        assert rec["new_tokens"] == 8
+        assert rec["tokens_per_s"] > 0
+        assert rec["ms_per_token"] > 0
+        int8 = rec["int8"]
+        assert int8["tokens_per_s"] > 0
+        # tiny random-weight model: int8 token agreement is near-total
+        assert int8["token_agreement"] >= 0.5
