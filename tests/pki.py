@@ -1,0 +1,115 @@
+"""In-test PKI: CA/server/client certificates for the TLS suites.
+
+Shared by test_tls.py and the TLS operator e2e (consumers call
+``pytest.importorskip("cryptography")`` before importing, since the
+package is an optional test extra).  The ``cryptography`` imports stay
+inside the functions so importing THIS module never fails."""
+
+from __future__ import annotations
+
+import datetime
+
+
+def make_key():
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def _name(cn: str):
+    from cryptography import x509
+    from cryptography.x509.oid import NameOID
+
+    return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+
+def make_cert(subject_key, subject_cn, issuer_cert=None, issuer_key=None,
+              is_ca=False, san_ip=None):
+    import ipaddress
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+
+    issuer_name = (
+        issuer_cert.subject if issuer_cert is not None
+        else _name(subject_cn)
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(_name(subject_cn))
+        .issuer_name(issuer_name)
+        .public_key(subject_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(hours=2))
+        .add_extension(
+            x509.BasicConstraints(ca=is_ca, path_length=None), critical=True
+        )
+    )
+    if san_ip:
+        builder = builder.add_extension(
+            x509.SubjectAlternativeName(
+                [x509.IPAddress(ipaddress.ip_address(san_ip))]
+            ),
+            critical=False,
+        )
+    signer = issuer_key if issuer_key is not None else subject_key
+    return builder.sign(signer, hashes.SHA256())
+
+
+def pem_cert(cert) -> bytes:
+    from cryptography.hazmat.primitives.serialization import Encoding
+
+    return cert.public_bytes(Encoding.PEM)
+
+
+def pem_key(key) -> bytes:
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        NoEncryption,
+        PrivateFormat,
+    )
+
+    return key.private_bytes(
+        Encoding.PEM, PrivateFormat.TraditionalOpenSSL, NoEncryption()
+    )
+
+
+def write_pki(directory) -> dict:
+    """CA + server cert (SAN 127.0.0.1) + client cert as PEM files in
+    *directory*; returns name -> path."""
+    import os
+
+    ca_key = make_key()
+    ca = make_cert(ca_key, "test-ca", is_ca=True)
+    server_key = make_key()
+    server = make_cert(server_key, "apiserver", issuer_cert=ca,
+                       issuer_key=ca_key, san_ip="127.0.0.1")
+    client_key = make_key()
+    client = make_cert(client_key, "operator-client", issuer_cert=ca,
+                       issuer_key=ca_key)
+    paths = {}
+    for name, data in (
+        ("ca.pem", pem_cert(ca)),
+        ("server.pem", pem_cert(server)),
+        ("server.key", pem_key(server_key)),
+        ("client.pem", pem_cert(client)),
+        ("client.key", pem_key(client_key)),
+    ):
+        path = os.path.join(str(directory), name)
+        with open(path, "wb") as fh:
+            fh.write(data)
+        paths[name] = path
+    return paths
+
+
+def server_context(paths: dict, require_client_cert: bool = False):
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(paths["server.pem"], paths["server.key"])
+    if require_client_cert:
+        ctx.load_verify_locations(paths["ca.pem"])
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx

@@ -3274,3 +3274,49 @@ class TestInClusterConfig:
         monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "h")
         with pytest.raises(kc.KubeConfigError, match="SA token"):
             kc.KubeConfig.in_cluster()
+
+
+class TestClientErrorBranches:
+    """Small error paths of KubeApiClient the rollout suites skip.
+    All are client-side / pure — no server needed (the unsupported
+    patch type is rejected before any request leaves the process)."""
+
+    @staticmethod
+    def _offline_client():
+        # nothing listens on port 1; these paths never hit the network
+        return KubeApiClient(KubeConfig(server="http://127.0.0.1:1"),
+                             timeout=1.0)
+
+    def test_unsupported_patch_type_rejected(self):
+        from k8s_operator_libs_tpu.cluster import BadRequestError
+
+        client = self._offline_client()
+        with pytest.raises(BadRequestError, match="unsupported patch"):
+            client.patch("Node", "n1", {"metadata": {}}, patch_type="json")
+
+    def test_seed_bookmark_tolerates_malformed_rv(self):
+        client = self._offline_client()
+        # a body whose resourceVersion is not an int must not raise
+        assert client._seed_bookmark(
+            "Node", {"metadata": {"resourceVersion": "not-an-int"}}
+        ) in (None, 0)
+        assert client._seed_bookmark("Node", {}) in (None, 0)
+
+    def test_status_reason_maps_to_error_classes(self):
+        from k8s_operator_libs_tpu.cluster.errors import (
+            ApiError,
+            BadRequestError,
+            InvalidError,
+        )
+
+        client = self._offline_client()
+        assert isinstance(
+            client._to_api_error(400, {"message": "m"}), BadRequestError
+        )
+        assert isinstance(
+            client._to_api_error(422, {"message": "m", "reason": "Invalid"}),
+            InvalidError,
+        )
+        # unknown status falls back to the base class
+        err = client._to_api_error(508, {"message": "m"})
+        assert type(err) is ApiError

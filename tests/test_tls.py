@@ -12,7 +12,6 @@ stale, no openssl subprocess)."""
 
 from __future__ import annotations
 
-import datetime
 import ssl
 
 import pytest
@@ -30,105 +29,17 @@ from k8s_operator_libs_tpu.cluster import (
 from k8s_operator_libs_tpu.cluster.objects import make_node
 
 
-# --------------------------------------------------------------- certs
-def _make_key():
-    from cryptography.hazmat.primitives.asymmetric import rsa
-
-    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
-
-
-def _name(cn: str):
-    from cryptography import x509
-    from cryptography.x509.oid import NameOID
-
-    return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
-
-
-def _cert(subject_key, subject_cn, issuer_cert=None, issuer_key=None,
-          is_ca=False, san_ip=None):
-    import ipaddress
-
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes
-
-    issuer_name = (
-        issuer_cert.subject if issuer_cert is not None
-        else _name(subject_cn)
-    )
-    now = datetime.datetime.now(datetime.timezone.utc)
-    builder = (
-        x509.CertificateBuilder()
-        .subject_name(_name(subject_cn))
-        .issuer_name(issuer_name)
-        .public_key(subject_key.public_key())
-        .serial_number(x509.random_serial_number())
-        .not_valid_before(now - datetime.timedelta(minutes=5))
-        .not_valid_after(now + datetime.timedelta(hours=2))
-        .add_extension(
-            x509.BasicConstraints(ca=is_ca, path_length=None), critical=True
-        )
-    )
-    if san_ip:
-        builder = builder.add_extension(
-            x509.SubjectAlternativeName(
-                [x509.IPAddress(ipaddress.ip_address(san_ip))]
-            ),
-            critical=False,
-        )
-    signer = issuer_key if issuer_key is not None else subject_key
-    return builder.sign(signer, hashes.SHA256())
-
-
-def _pem_cert(cert) -> bytes:
-    from cryptography.hazmat.primitives.serialization import Encoding
-
-    return cert.public_bytes(Encoding.PEM)
-
-
-def _pem_key(key) -> bytes:
-    from cryptography.hazmat.primitives.serialization import (
-        Encoding,
-        NoEncryption,
-        PrivateFormat,
-    )
-
-    return key.private_bytes(
-        Encoding.PEM, PrivateFormat.TraditionalOpenSSL, NoEncryption()
-    )
+from pki import server_context as _server_ctx_impl, write_pki
 
 
 @pytest.fixture(scope="module")
 def pki(tmp_path_factory):
     """CA + server cert (SAN 127.0.0.1) + client cert, as PEM files."""
-    d = tmp_path_factory.mktemp("pki")
-    ca_key = _make_key()
-    ca = _cert(ca_key, "test-ca", is_ca=True)
-    server_key = _make_key()
-    server = _cert(server_key, "apiserver", issuer_cert=ca,
-                   issuer_key=ca_key, san_ip="127.0.0.1")
-    client_key = _make_key()
-    client = _cert(client_key, "operator-client", issuer_cert=ca,
-                   issuer_key=ca_key)
-    paths = {}
-    for name, data in (
-        ("ca.pem", _pem_cert(ca)),
-        ("server.pem", _pem_cert(server)),
-        ("server.key", _pem_key(server_key)),
-        ("client.pem", _pem_cert(client)),
-        ("client.key", _pem_key(client_key)),
-    ):
-        (d / name).write_bytes(data)
-        paths[name] = str(d / name)
-    return paths
+    return write_pki(tmp_path_factory.mktemp("pki"))
 
 
 def _server_ctx(pki, require_client_cert=False) -> ssl.SSLContext:
-    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-    ctx.load_cert_chain(pki["server.pem"], pki["server.key"])
-    if require_client_cert:
-        ctx.load_verify_locations(pki["ca.pem"])
-        ctx.verify_mode = ssl.CERT_REQUIRED
-    return ctx
+    return _server_ctx_impl(pki, require_client_cert)
 
 
 # --------------------------------------------------------------- specs
@@ -220,7 +131,7 @@ class TestExecIssuedClientCert:
 
     def test_mtls_via_exec_plugin(self, pki, tmp_path):
         import json as _json
-        import sys as _sys
+        from pathlib import Path as _Path
 
         from test_execauth import (
             API_VERSION,
@@ -235,10 +146,12 @@ class TestExecIssuedClientCert:
                     "apiVersion": API_VERSION,
                     "kind": "ExecCredential",
                     "status": {
-                        "clientCertificateData": open(
+                        "clientCertificateData": _Path(
                             pki["client.pem"]
-                        ).read(),
-                        "clientKeyData": open(pki["client.key"]).read(),
+                        ).read_text(),
+                        "clientKeyData": _Path(
+                            pki["client.key"]
+                        ).read_text(),
                     },
                 }
             )
@@ -251,11 +164,12 @@ class TestExecIssuedClientCert:
             # entry at the test CA so server verification passes
             import yaml as _yaml
 
-            cfg = _yaml.safe_load(open(kubeconfig))
+            kc_path = _Path(kubeconfig)
+            cfg = _yaml.safe_load(kc_path.read_text())
             cfg["clusters"][0]["cluster"]["certificate-authority"] = pki[
                 "ca.pem"
             ]
-            open(kubeconfig, "w").write(_yaml.safe_dump(cfg))
+            kc_path.write_text(_yaml.safe_dump(cfg))
             client = KubeApiClient(KubeConfig.load(kubeconfig), timeout=10.0)
             client.create(make_node("n-exec-mtls"))
             assert client.exists("Node", "n-exec-mtls")
