@@ -691,3 +691,84 @@ class TestInformerCacheRefreshRace:
         assert got["metadata"]["resourceVersion"] == live["metadata"][
             "resourceVersion"
         ]
+
+
+class TestBlobJournal:
+    """The journal's lazy blob-backed events (the 4,096-node-probe
+    optimization): semantics must be indistinguishable from the old
+    tree-copy journal."""
+
+    def test_events_lazy_until_accessed(self):
+        from k8s_operator_libs_tpu.cluster.inmem import InMemoryCluster
+        from k8s_operator_libs_tpu.cluster.objects import make_node
+
+        c = InMemoryCluster()
+        c.create(make_node("n1"))
+        c.patch("Node", "n1", {"metadata": {"labels": {"a": "1"}}})
+        evs = c.events_since(0, kind="Node")
+        assert [e.type for e in evs] == ["Added", "Modified"]
+        # kind filtering happened WITHOUT materializing the trees
+        assert all(e.kind == "Node" for e in evs)
+        assert evs[-1]._new is None and evs[-1]._new_blob is not None
+        # access materializes once and caches
+        assert evs[-1].new["metadata"]["labels"] == {"a": "1"}
+        assert evs[-1]._new_blob is None
+
+    def test_consumer_mutation_cannot_corrupt_store(self):
+        from k8s_operator_libs_tpu.cluster.inmem import InMemoryCluster
+        from k8s_operator_libs_tpu.cluster.objects import make_node
+
+        c = InMemoryCluster()
+        c.create(make_node("n1"))
+        c.patch("Node", "n1", {"metadata": {"labels": {"a": "1"}}})
+        ev = c.events_since(0, kind="Node")[-1]
+        ev.new["metadata"]["labels"]["a"] = "CORRUPTED"
+        ev.old["metadata"]["name"] = "CORRUPTED"
+        assert c.get("Node", "n1")["metadata"]["labels"] == {"a": "1"}
+        assert c.get("Node", "n1")["metadata"]["name"] == "n1"
+
+    def test_consumers_share_one_materialized_tree(self):
+        # the pre-blob contract: every events_since caller saw the SAME
+        # event objects/trees — preserved so memory does not regress
+        from k8s_operator_libs_tpu.cluster.inmem import InMemoryCluster
+        from k8s_operator_libs_tpu.cluster.objects import make_node
+
+        c = InMemoryCluster()
+        c.create(make_node("n1"))
+        a = c.events_since(0, kind="Node")[0]
+        b = c.events_since(0, kind="Node")[0]
+        assert a is b
+        assert a.new is b.new
+
+    def test_pre_image_is_the_pre_patch_state(self):
+        from k8s_operator_libs_tpu.cluster.inmem import InMemoryCluster
+        from k8s_operator_libs_tpu.cluster.objects import make_node
+
+        c = InMemoryCluster()
+        c.create(make_node("n1"))
+        c.patch("Node", "n1", {"metadata": {"labels": {"step": "1"}}})
+        c.patch("Node", "n1", {"metadata": {"labels": {"step": "2"}}})
+        evs = c.events_since(0, kind="Node")
+        assert (evs[2].old["metadata"]["labels"]) == {"step": "1"}
+        assert (evs[2].new["metadata"]["labels"]) == {"step": "2"}
+        # delete pre-image is the final state
+        c.delete("Node", "n1")
+        ev = c.events_since(0, kind="Node")[-1]
+        assert ev.type == "Deleted"
+        assert ev.old["metadata"]["labels"] == {"step": "2"}
+        assert ev.new is None
+
+    def test_unmarshalable_tree_falls_back_to_copies(self):
+        from k8s_operator_libs_tpu.cluster.inmem import InMemoryCluster
+        from k8s_operator_libs_tpu.cluster.objects import make_node
+
+        class Helper:  # not marshal-able
+            pass
+
+        c = InMemoryCluster()
+        node = make_node("n1")
+        node["metadata"]["helper"] = Helper()
+        c.create(node)
+        ev = c.events_since(0, kind="Node")[0]
+        assert isinstance(ev.new["metadata"]["helper"], Helper)
+        assert c.get("Node", "n1")["metadata"]["name"] == "n1"
