@@ -554,6 +554,9 @@ def main() -> None:
     scale_1k_rate, scale_1k_s = scale_probe(256, 4)
     scale_4k_rate, scale_4k_s = scale_probe(1024, 4)
     scale_4k_gcoff_rate, scale_4k_gcoff_s = scale_probe(1024, 4, tuned=False)
+    # 8,192 nodes: double the r4 ceiling — the blob-journal rewrite made
+    # this probe affordable (~8 s/run) and it guards the next falloff
+    scale_8k_rate, scale_8k_s = scale_probe(2048, 4)
 
     # ---- HTTP path: the production loop over real localhost HTTP with
     # server-enforced pages and held watch streams — the 48-node lagged
@@ -668,6 +671,11 @@ def main() -> None:
                     ),
                     "scale_retention_4096_vs_1024": round(
                         scale_4k_rate / scale_1k_rate, 3
+                    ),
+                    "scale_8192_nodes_per_min": round(scale_8k_rate, 2),
+                    "scale_8192_wall_s": round(scale_8k_s, 2),
+                    "scale_retention_8192_vs_4096": round(
+                        scale_8k_rate / scale_4k_rate, 3
                     ),
                 },
             }

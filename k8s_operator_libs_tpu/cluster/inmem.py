@@ -181,32 +181,34 @@ class WatchEvent:
         self._new_blob = new_blob
         self.kind = kind or ((new or old or {}).get("kind") or "")
 
-    # Lazy materialization is lock-free but race-benign: the blob is
-    # read into a LOCAL before the loads, so a concurrent first access
-    # can never see the attribute cleared mid-sequence (events are
-    # consumed from held-watch handler threads, the informer cache, and
-    # the controller loop simultaneously).  The `self._old is None`
-    # re-check keeps late writers from replacing an already-shared tree.
+    # Double-checked locking: events are consumed from held-watch
+    # handler threads, the informer cache, and the controller loop
+    # simultaneously, and every consumer must share ONE materialized
+    # tree (pinned by TestBlobJournal).  The lock is module-shared —
+    # per-event locks would cost a slot + object on millions of
+    # journal entries; contention only exists during a first access.
 
     @property
     def old(self) -> Optional[JsonObj]:
-        blob = self._old_blob
-        if self._old is None and blob is not None:
-            tree = marshal.loads(blob)
-            if self._old is None:
-                self._old = tree
-                self._old_blob = None
+        if self._old is None and self._old_blob is not None:
+            with _MATERIALIZE_LOCK:
+                if self._old is None and self._old_blob is not None:
+                    self._old = marshal.loads(self._old_blob)
+                    self._old_blob = None
         return self._old
 
     @property
     def new(self) -> Optional[JsonObj]:
-        blob = self._new_blob
-        if self._new is None and blob is not None:
-            tree = marshal.loads(blob)
-            if self._new is None:
-                self._new = tree
-                self._new_blob = None
+        if self._new is None and self._new_blob is not None:
+            with _MATERIALIZE_LOCK:
+                if self._new is None and self._new_blob is not None:
+                    self._new = marshal.loads(self._new_blob)
+                    self._new_blob = None
         return self._new
+
+
+#: Shared by every WatchEvent's lazy materialization (see above).
+_MATERIALIZE_LOCK = threading.Lock()
 
 
 class InMemoryCluster:
@@ -328,12 +330,17 @@ class InMemoryCluster:
             del self._journal[:evicted]
         self._journal_cond.notify_all()
 
-    def _blob_of(self, key: Key, obj: JsonObj) -> Optional[bytes]:
+    def _blob_of(
+        self, key: Key, obj: JsonObj, prime: bool = True
+    ) -> Optional[bytes]:
         """Marshal blob of a stored object, reusing/priming the
         rv-validated read cache (one dumps serves the journal, the
         write's return value, AND every later get/list of this rv).
         None when the tree is unmarshalable or carries no rv — callers
-        fall back to tree copies."""
+        fall back to tree copies.  ``prime=False`` skips the cache
+        insertion — delete paths need the journal blob but must not
+        cache (and at cap, CLEAR the warm cache for) a key that is
+        being removed."""
         rv = rv_str(obj)
         if rv is None:
             return None
@@ -344,10 +351,38 @@ class InMemoryCluster:
             blob = marshal.dumps(obj)
         except ValueError:
             return None
-        if len(self._blobs) >= self._blob_cap:
-            self._blobs.clear()
-        self._blobs[key] = (rv, blob)
+        if prime:
+            if len(self._blobs) >= self._blob_cap:
+                self._blobs.clear()
+            self._blobs[key] = (rv, blob)
         return blob
+
+    def _record_write(
+        self,
+        key: Key,
+        type_: str,
+        old: Optional[JsonObj],
+        old_blob: Optional[bytes],
+        stored: JsonObj,
+        kind: str,
+    ) -> JsonObj:
+        """Journal a write of *stored* (already in the store) and return
+        the caller's hand-out copy — the blob-vs-tree-fallback dance
+        shared by create/update/patch."""
+        new_blob = self._blob_of(key, stored)
+        self._record(
+            type_,
+            old,
+            None if new_blob is not None else json_copy(stored),
+            kind=kind,
+            old_blob=old_blob,
+            new_blob=new_blob,
+        )
+        return (
+            marshal.loads(new_blob)
+            if new_blob is not None
+            else json_copy(stored)
+        )
 
     # -------------------------------------------------------------- admission
     def _admit(self, obj: JsonObj) -> None:
@@ -405,16 +440,9 @@ class InMemoryCluster:
             # One marshal.dumps serves the journal entry, this return
             # value, and every later get/list of this rv (profiled: the
             # old triple json_copy dominated the 4,096-node probe)
-            new_blob = self._blob_of(key, stored)
-            if new_blob is not None:
-                self._record(
-                    "Added", None, None,
-                    kind=stored.get("kind") or "", new_blob=new_blob,
-                )
-                result = marshal.loads(new_blob)
-            else:
-                self._record("Added", None, json_copy(stored))
-                result = json_copy(stored)
+            result = self._record_write(
+                key, "Added", None, None, stored, stored.get("kind") or ""
+            )
         if stored.get("kind") == "CustomResourceDefinition":
             self._schedule_crd_establishment(key)
         return result
@@ -751,19 +779,8 @@ class InMemoryCluster:
                 )
                 return json_copy(stored)
             self._store_put(key, stored)
-            new_blob = self._blob_of(key, stored)
-            self._record(
-                "Modified",
-                old,
-                None if new_blob is not None else json_copy(stored),
-                kind=kindname,
-                old_blob=old_blob,
-                new_blob=new_blob,
-            )
-            return (
-                marshal.loads(new_blob)
-                if new_blob is not None
-                else json_copy(stored)
+            return self._record_write(
+                key, "Modified", old, old_blob, stored, kindname
             )
 
     #: Status subresource writes share update semantics here (envtest-style
@@ -833,19 +850,8 @@ class InMemoryCluster:
                 )
                 return json_copy(merged)
             self._store_put(key, merged)
-            new_blob = self._blob_of(key, merged)
-            self._record(
-                "Modified",
-                old,
-                None if new_blob is not None else json_copy(merged),
-                kind=kind,
-                old_blob=old_blob,
-                new_blob=new_blob,
-            )
-            return (
-                marshal.loads(new_blob)
-                if new_blob is not None
-                else json_copy(merged)
+            return self._record_write(
+                key, "Modified", old, old_blob, merged, kind
             )
 
     def delete(
@@ -882,7 +888,7 @@ class InMemoryCluster:
             if kind == "Pod":
                 if meta.get("deletionTimestamp"):
                     if grace_period_seconds == 0 and not meta.get("finalizers"):
-                        old_blob = self._blob_of(key, obj)
+                        old_blob = self._blob_of(key, obj, prime=False)
                         self._store_pop(key)
                         self._next_rv()
                         self._record(
@@ -919,7 +925,7 @@ class InMemoryCluster:
                     obj["metadata"]["resourceVersion"] = self._next_rv()
                     self._record("Modified", old, json_copy(obj))
                 return
-            old_blob = self._blob_of(key, obj)
+            old_blob = self._blob_of(key, obj, prime=False)
             self._store_pop(key)
             if kind == "CustomResourceDefinition":
                 self._unregister_crd_schema(obj)
@@ -942,7 +948,7 @@ class InMemoryCluster:
                 return  # already gone or name reused
             if obj["metadata"].get("finalizers"):
                 return
-            old_blob = self._blob_of(key, obj)
+            old_blob = self._blob_of(key, obj, prime=False)
             self._store_pop(key)
             self._next_rv()
             self._record(
