@@ -16,9 +16,9 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 # Enforced coverage floor (VERDICT r4 next #6).  Full-suite line
 # coverage measured by the zero-dependency sys.monitoring tracer
 # (hack/cover.py; pytest-cov is not installable here) was 92.2% when
-# the floor was set — raise the floor as coverage rises, never lower
-# it to make a failure pass.
-COV_FLOOR ?= 90
+# the floor was first set and 93.6% when it was raised to 91 — raise
+# the floor as coverage rises, never lower it to make a failure pass.
+COV_FLOOR ?= 91
 
 all: lint test
 
