@@ -401,12 +401,17 @@ class TestHaOperator:
         def __init__(self):
             self.started = 0
             self.stopped = 0
+            self.alive = True
 
         def start(self, workers=1):
             self.started += 1
 
         def stop(self, timeout=10.0):
             self.stopped += 1
+            self.alive = False
+
+        def running(self):
+            return self.alive
 
     def _make(self, cluster, identity, built):
         from k8s_operator_libs_tpu.controller import HaOperator
@@ -467,3 +472,23 @@ class TestHaOperator:
         assert len(built) == 2
         assert built[0] is not built[1]
         op2.stop()
+
+
+class TestHaOperatorLiveness:
+    """HaOperator.running(): the probe wired to /healthz — a dead
+    campaign thread or a dead promoted controller must fail it while a
+    hot standby stays healthy."""
+
+    def test_running_truth_table(self):
+        cluster = InMemoryCluster()
+        built = []
+        op = TestHaOperator()._make(cluster, "probe", built)
+        assert op.running() is False  # not started: campaign dead
+        op.start()
+        assert wait_for(lambda: op.is_leader)
+        assert op.running() is True  # leading + controller alive
+        # leader whose controller died must fail the probe
+        built[0].alive = False
+        assert op.running() is False
+        op.stop()
+        assert op.running() is False

@@ -1275,3 +1275,39 @@ class TestDrainGracePeriod:
             cluster.get("Node", "n1")["metadata"]["labels"][state_key]
             == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
         )
+
+
+class TestValidationManagerEdges:
+    """The timeout-clock branches (reference handleTimeout,
+    validation_manager.go:139-175): malformed start-time reset and
+    the pod-readiness predicate's empty-statuses rule."""
+
+    def test_malformed_start_time_resets_clock(self, cluster, provider):
+        node = cluster.create(make_node("n1"))
+        key = util.get_validation_start_time_annotation_key()
+        provider.change_node_upgrade_annotation(node, key, "not-a-number")
+        pod = make_pod("val", "ops", "n1", labels={"app": "validator"})
+        pod["status"]["containerStatuses"] = [{"name": "c", "ready": False}]
+        cluster.create(pod)
+        mgr = ValidationManager(
+            cluster, provider, pod_selector="app=validator",
+            timeout_seconds=600,
+        )
+        assert mgr.validate(node) is False
+        fresh = get_annotation(cluster.get("Node", "n1"), key)
+        assert fresh != "not-a-number" and float(fresh) > 0
+        # a reset clock must NOT fail the node
+        assert state_of(cluster, "n1") != consts.UPGRADE_STATE_FAILED
+
+    def test_running_pod_with_no_container_statuses_not_ready(
+        self, cluster, provider
+    ):
+        node = cluster.create(make_node("n1"))
+        pod = make_pod("val", "ops", "n1", labels={"app": "validator"})
+        pod["status"]["containerStatuses"] = []  # reference: not ready
+        cluster.create(pod)
+        mgr = ValidationManager(
+            cluster, provider, pod_selector="app=validator",
+            timeout_seconds=600,
+        )
+        assert mgr.validate(node) is False
