@@ -47,10 +47,15 @@ smoke:
 	$(PYTHON) examples/apply_crds.py --crds-path hack/crd/bases --operation delete --state-file /tmp/k8s-op-tpu-smoke.json
 	rm -f /tmp/k8s-op-tpu-smoke.json
 
-# PALLAS_AXON_POOL_IPS= disables any baked-in PJRT plugin hook so the
-# dryrun really runs on 8 virtual CPU devices.
+# PALLAS_AXON_POOL_IPS= disables any baked-in PJRT plugin hook so BOTH
+# steps run on CPU — the entry step previously inherited the pool hint
+# and wedged inside import jax whenever the accelerator tunnel was
+# down (the tunnel's known failure mode; see hack/tpu_probe.py).  The
+# driver compiles entry() on real silicon itself; this target is the
+# hardware-free sanity gate.
 graft-check:
-	$(PYTHON) -c "import __graft_entry__ as g; fn, args = g.entry(); print('entry ok')"
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		$(PYTHON) -c "import __graft_entry__ as g; fn, args = g.entry(); print('entry ok')"
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
