@@ -939,12 +939,12 @@ class KubeApiClient:
         # so dropping them here would lose the deltas for good.
         with self._last_seen_lock:
             events = [
-                e for e in self._pending_events if (e.new or e.old or {}).get("kind") in kinds
+                e for e in self._pending_events if e.kind in kinds
             ]
             self._pending_events = [
                 e
                 for e in self._pending_events
-                if (e.new or e.old or {}).get("kind") not in kinds
+                if e.kind not in kinds
             ]
         for k in kinds:
             info = KIND_REGISTRY[k]
@@ -1030,11 +1030,11 @@ class KubeApiClient:
                 k: self._kind_delivered.get(k, seq) for k in kinds
             }
             for e in events:
-                ek = (e.new or e.old or {}).get("kind")
+                ek = e.kind
                 if ek not in floors or e.seq > floors[ek]:
                     delivered.append(e)
             for e in delivered:
-                ek = (e.new or e.old or {}).get("kind")
+                ek = e.kind
                 if ek in floors:
                     self._kind_delivered[ek] = max(
                         self._kind_delivered.get(ek, 0), e.seq
@@ -1240,15 +1240,13 @@ class KubeApiClient:
         # they are stranded for good — the held branch never reads the
         # pending stash.
         with self._last_seen_lock:
+            # e.kind (the WatchEvent slot), never e.new/e.old: blob-
+            # backed events must not materialize for a kind filter
             flush = [
-                e
-                for e in self._pending_events
-                if (e.new or e.old or {}).get("kind") in wanted
+                e for e in self._pending_events if e.kind in wanted
             ]
             self._pending_events = [
-                e
-                for e in self._pending_events
-                if (e.new or e.old or {}).get("kind") not in wanted
+                e for e in self._pending_events if e.kind not in wanted
             ]
         for e in flush:
             self._held_enqueue(e)
@@ -1292,8 +1290,7 @@ class KubeApiClient:
             events = []
             keep = deque()
             for e in self._held_queue:
-                obj = e.new or e.old or {}
-                if obj.get("kind") in wanted:
+                if e.kind in wanted:
                     events.append(e)
                 else:
                     keep.append(e)

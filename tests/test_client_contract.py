@@ -3320,3 +3320,132 @@ class TestClientErrorBranches:
         # unknown status falls back to the base class
         err = client._to_api_error(508, {"message": "m"})
         assert type(err) is ApiError
+
+
+class TestKubeconfigLoadErrors:
+    """KubeConfig.load error/lookup branches (rest-config loading parity
+    with the reference's ctrl.GetConfig, crdutil.go:56-67): KUBECONFIG
+    env fallback, unreadable file, missing context/cluster entries,
+    explicit context selection."""
+
+    @staticmethod
+    def _write(tmp_path, doc):
+        import yaml as _yaml
+
+        path = tmp_path / "kubeconfig"
+        path.write_text(_yaml.safe_dump(doc))
+        return str(path)
+
+    def _doc(self, **over):
+        doc = {
+            "apiVersion": "v1",
+            "kind": "Config",
+            "current-context": "a",
+            "contexts": [
+                {"name": "a", "context": {"cluster": "c1", "user": "u"}},
+                {"name": "b", "context": {"cluster": "c2", "user": "u"}},
+            ],
+            "clusters": [
+                {"name": "c1", "cluster": {"server": "http://one:1"}},
+                {"name": "c2", "cluster": {"server": "http://two:2"}},
+            ],
+            "users": [{"name": "u", "user": {"token": "t"}}],
+        }
+        doc.update(over)
+        return doc
+
+    def test_kubeconfig_env_fallback(self, tmp_path, monkeypatch):
+        from k8s_operator_libs_tpu.cluster import KubeConfig
+
+        path = self._write(tmp_path, self._doc())
+        monkeypatch.setenv("KUBECONFIG", path)
+        cfg = KubeConfig.load()
+        assert cfg.server == "http://one:1"
+        assert cfg.token == "t"
+
+    def test_explicit_context_selects_cluster(self, tmp_path):
+        from k8s_operator_libs_tpu.cluster import KubeConfig
+
+        path = self._write(tmp_path, self._doc())
+        assert KubeConfig.load(path, context="b").server == "http://two:2"
+
+    def test_unreadable_file_raises(self, tmp_path):
+        from k8s_operator_libs_tpu.cluster import KubeConfig
+        from k8s_operator_libs_tpu.cluster.kubeclient import KubeConfigError
+
+        with pytest.raises(KubeConfigError, match="cannot read"):
+            KubeConfig.load(str(tmp_path / "absent"))
+
+    def test_missing_current_context_raises(self, tmp_path):
+        from k8s_operator_libs_tpu.cluster import KubeConfig
+        from k8s_operator_libs_tpu.cluster.kubeclient import KubeConfigError
+
+        path = self._write(tmp_path, self._doc(**{"current-context": ""}))
+        with pytest.raises(KubeConfigError, match="no current-context"):
+            KubeConfig.load(path)
+
+    def test_unknown_context_raises(self, tmp_path):
+        from k8s_operator_libs_tpu.cluster import KubeConfig
+        from k8s_operator_libs_tpu.cluster.kubeclient import KubeConfigError
+
+        path = self._write(tmp_path, self._doc())
+        with pytest.raises(KubeConfigError, match="not found"):
+            KubeConfig.load(path, context="nope")
+
+    def test_context_pointing_at_missing_cluster_raises(self, tmp_path):
+        from k8s_operator_libs_tpu.cluster import KubeConfig
+        from k8s_operator_libs_tpu.cluster.kubeclient import KubeConfigError
+
+        doc = self._doc()
+        doc["clusters"] = [doc["clusters"][1]]  # drop c1
+        path = self._write(tmp_path, doc)
+        with pytest.raises(KubeConfigError, match="cluster 'c1'"):
+            KubeConfig.load(path)
+
+
+class TestOverloadReplayHeaderParsing:
+    """The APF 429 replay's Retry-After parsing: a malformed header
+    must fall back to 1s (clamped), not crash the replay loop."""
+
+    def test_malformed_retry_after_falls_back(self, monkeypatch):
+        import json as _json
+
+        from k8s_operator_libs_tpu.cluster import KubeApiClient, KubeConfig
+
+        client = KubeApiClient(
+            KubeConfig(server="http://127.0.0.1:1"), timeout=1.0
+        )
+
+        class FakeResp:
+            def __init__(self, status, headers=None):
+                self.status = status
+                self._headers = headers or {}
+
+            def getheader(self, name):
+                return self._headers.get(name)
+
+        calls = {"n": 0}
+        ok_body = _json.dumps(
+            {"kind": "Node", "metadata": {"name": "n1",
+                                          "resourceVersion": "5"}}
+        ).encode()
+
+        def fake_transport(method, path, payload, content_type,
+                           refresh_if_generation=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return (
+                    FakeResp(429, {
+                        "X-Kubernetes-PF-FlowSchema-UID": "apf",
+                        "Retry-After": "soon",  # unparseable
+                    }),
+                    b"{}",
+                )
+            return FakeResp(200), ok_body
+
+        monkeypatch.setattr(client, "_transport", fake_transport)
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        _, body = client._request("GET", "/api/v1/nodes/n1")
+        assert body["metadata"]["name"] == "n1"
+        assert client.overload_retries == 1
+        assert calls["n"] == 2
