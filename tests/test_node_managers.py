@@ -1311,3 +1311,49 @@ class TestValidationManagerEdges:
             timeout_seconds=600,
         )
         assert mgr.validate(node) is False
+
+
+class TestPipelineBarrierErrors:
+    """pipelined_writes' deliberate 'late' failure mode: a failed patch
+    surfaces at the barrier, AFTER every in-flight write settles (later
+    writes are never abandoned mid-flight), and the pool survives for
+    the next pass."""
+
+    def test_first_failure_reraised_after_all_settle(
+        self, cluster, provider
+    ):
+        n1 = cluster.create(make_node("n1"))
+        n2 = cluster.create(make_node("n2"))
+        ghost = make_node("ghost")  # never created: its patch 404s
+        with provider.pipelined_writes(max_workers=4):
+            provider.change_node_upgrade_state(n1, consts.UPGRADE_STATE_CORDON_REQUIRED)
+            provider.change_node_upgrade_state(ghost, consts.UPGRADE_STATE_CORDON_REQUIRED)
+            provider.change_node_upgrade_state(n2, consts.UPGRADE_STATE_CORDON_REQUIRED)
+            with pytest.raises(Exception) as exc:
+                provider.pipeline_barrier()
+            assert "ghost" in str(exc.value) or "not found" in str(
+                exc.value
+            ).lower()
+        # the non-failing writes still landed (never abandoned)
+        assert state_of(cluster, "n1") == consts.UPGRADE_STATE_CORDON_REQUIRED
+        assert state_of(cluster, "n2") == consts.UPGRADE_STATE_CORDON_REQUIRED
+        # the provider remains usable for the next pass
+        with provider.pipelined_writes(max_workers=4):
+            provider.change_node_upgrade_state(
+                cluster.get("Node", "n1"), consts.UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED
+            )
+            provider.pipeline_barrier()
+        assert state_of(cluster, "n1") == consts.UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED
+
+    def test_barrier_noop_outside_pipeline(self, cluster, provider):
+        provider.pipeline_barrier()  # must simply not raise
+
+    def test_nested_block_defers_to_outer(self, cluster, provider):
+        n1 = cluster.create(make_node("n1"))
+        with provider.pipelined_writes(max_workers=2):
+            with provider.pipelined_writes(max_workers=2):  # nested: no-op
+                provider.change_node_upgrade_state(
+                    n1, consts.UPGRADE_STATE_CORDON_REQUIRED
+                )
+            provider.pipeline_barrier()
+        assert state_of(cluster, "n1") == consts.UPGRADE_STATE_CORDON_REQUIRED
