@@ -156,6 +156,62 @@ def canary_census(
     )
 
 
+def quarantined_domains(
+    state: ClusterUpgradeState, policy: UpgradePolicySpec
+):
+    """Domains barred from STARTING an upgrade because a member host
+    has a degraded TPU (policy.quarantine_degraded; see tpu.health).
+    Returns None when the policy is off — no scan, no behavior change.
+    Mode-independent (the requestor handoff honors it too — handing a
+    degraded slice to the maintenance operator starts exactly the
+    disruption the quarantine exists to prevent).
+
+    Sources, unioned: live degradation signals (conditions/labels)
+    AND the quarantine annotation SliceHealthManager maintains — so a
+    manually stamped quarantine is honored even when no live signal
+    is present."""
+    if not policy.quarantine_degraded:
+        return None
+    from ..tpu import health, topology as topo
+
+    quarantine_key = util.get_quarantine_annotation_key()
+    nodes = [ns.node for ns in state.all_node_states()]
+    out = health.degraded_domains(nodes)
+    for node in nodes:
+        annotations = (node.get("metadata") or {}).get("annotations") or {}
+        if annotations.get(quarantine_key):
+            out.add(topo.domain_of(node))
+    return out
+
+
+def canary_budget(
+    state: ClusterUpgradeState,
+    policy: UpgradePolicySpec,
+) -> tuple:
+    """(remaining fresh-unit admissions, participating units) while the
+    canary stage holds, or ``(None, frozenset())`` once it has passed.
+
+    The mode-independent half of the canary gate (both the in-place
+    schedulers and the requestor handoff charge against this): fresh
+    units spend *remaining*; *participating* units (already stamped
+    into version exposure) keep flowing without re-charging.  Logs the
+    freeze exactly when the budget is actually holding work back."""
+    census = canary_census(state, policy)
+    if census.passed:
+        return None, frozenset()
+    if census.remaining == 0 and state.nodes_in(
+        consts.UPGRADE_STATE_UPGRADE_REQUIRED
+    ):
+        logger.info(
+            "canary stage: %d/%d domains succeeded, %d in flight — "
+            "admissions frozen until the canary completes",
+            len(census.successful),
+            policy.canary_domains,
+            len(census.in_flight),
+        )
+    return census.remaining, census.stamped
+
+
 class InplaceNodeStateManager:
     def __init__(self, common: CommonUpgradeManager) -> None:
         self._common = common
@@ -252,46 +308,13 @@ class InplaceNodeStateManager:
         itself is never cleared — pacing's trailing-hour count must
         survive generations) and are ignored.  A participant succeeded
         when all its nodes are upgrade-done."""
-        census = canary_census(state, policy)
-        if census.passed:
-            return None  # canary stage passed: fleet opens up
-        # Log only when the budget is actually holding work back — a
-        # soaking canary reconciles every few seconds for hours.
-        if census.remaining == 0 and state.nodes_in(
-            consts.UPGRADE_STATE_UPGRADE_REQUIRED
-        ):
-            logger.info(
-                "canary stage: %d/%d domains succeeded, %d in flight — "
-                "admissions frozen until the canary completes",
-                len(census.successful),
-                policy.canary_domains,
-                len(census.in_flight),
-            )
-        return census.remaining
+        remaining, _participating = canary_budget(state, policy)
+        return remaining
 
     def _quarantined_domains(
         self, state: ClusterUpgradeState, policy: UpgradePolicySpec
     ):
-        """Domains barred from STARTING an upgrade because a member host
-        has a degraded TPU (policy.quarantine_degraded; see tpu.health).
-        Returns None when the policy is off — no scan, no behavior change.
-
-        Sources, unioned: live degradation signals (conditions/labels)
-        AND the quarantine annotation SliceHealthManager maintains — so a
-        manually stamped quarantine is honored even when no live signal
-        is present."""
-        if not policy.quarantine_degraded:
-            return None
-        from ..tpu import health, topology as topo
-
-        quarantine_key = util.get_quarantine_annotation_key()
-        nodes = [ns.node for ns in state.all_node_states()]
-        out = health.degraded_domains(nodes)
-        for node in nodes:
-            annotations = (node.get("metadata") or {}).get("annotations") or {}
-            if annotations.get(quarantine_key):
-                out.add(topo.domain_of(node))
-        return out
+        return quarantined_domains(state, policy)
 
     def _prepare(self, node_state: NodeUpgradeState) -> bool:
         """Annotation/skip handling; returns False if the node must be

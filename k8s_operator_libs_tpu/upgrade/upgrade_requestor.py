@@ -332,10 +332,33 @@ class RequestorNodeStateManager:
 
         Schedule gates apply before the maintenance handoff too: outside
         the maintenance window no NEW NodeMaintenance CRs are created
-        (nodes already handed off continue), and hourly pacing caps how
-        many nodes may be handed off per pass (upgrade/schedule.py)."""
+        (nodes already handed off continue), hourly pacing caps how
+        many nodes may be handed off per pass (upgrade/schedule.py),
+        and ``canaryDomains`` caps fresh-UNIT handoffs until the canary
+        units all reach done (+soak) — the same blast-radius contract
+        as in-place mode; a consumer switching modes must not silently
+        lose canary protection.  Units already participating (stamped,
+        in flight) keep handing off their remaining member nodes
+        without re-charging the budget."""
         common = self._common
         self.set_default_node_maintenance(policy)
+        # Canary accounting is mode-independent (admitted-at/done-at
+        # stamps + state buckets): ride the same budget as in-place.
+        canary_remaining: Optional[int] = None
+        participating: set = set()
+        quarantined = None
+        if policy is not None:
+            if policy.canary_domains > 0:
+                from .upgrade_inplace import canary_budget
+
+                canary_remaining, stamped = canary_budget(state, policy)
+                participating = set(stamped)
+            if policy.quarantine_degraded:
+                from .upgrade_inplace import quarantined_domains
+
+                quarantined = quarantined_domains(state, policy)
+        if canary_remaining is not None or quarantined:
+            from ..tpu import topology
         # The window gates only the NodeMaintenance HANDOFF — the
         # upgrade-requested annotation housekeeping the reference performs
         # in ProcessUpgradeRequiredNodes (:283-296) runs regardless, so a
@@ -363,10 +386,35 @@ class RequestorNodeStateManager:
                 continue
             if window_closed:
                 continue  # housekeeping done; handoff gated by the window
+            # Gate checks first, budgets charged only at ADMISSION
+            # (in-place parity: a node another gate denies must not
+            # spend a budget it never used).
+            if quarantined:
+                if topology.domain_of(node) in quarantined:
+                    logger.info(
+                        "node %s: domain quarantined (degraded TPU) — "
+                        "maintenance handoff withheld",
+                        name_of(node),
+                    )
+                    continue
+            fresh_unit = None
+            if canary_remaining is not None:
+                unit = (
+                    topology.domain_of(node)
+                    if policy.slice_aware
+                    else "node:" + name_of(node)
+                )
+                if unit not in participating:
+                    if canary_remaining <= 0:
+                        continue  # canary frozen or budget spent
+                    fresh_unit = unit
             if pacing is not None:
                 if pacing <= 0:
                     continue  # hourly pacing budget spent
                 pacing -= 1
+            if fresh_unit is not None:
+                canary_remaining -= 1
+                participating.add(fresh_unit)
             self.create_or_update_node_maintenance(node_state)
             # stamp only after the handoff succeeded: a failed create must
             # not burn an hour of pacing budget for a node never admitted

@@ -783,3 +783,143 @@ class TestPostMaintenanceGate:
         assert mop.reconcile() == 1
         reconcile(manager, fleet, policy)
         assert fleet.node_state("n1") == consts.UPGRADE_STATE_POD_RESTART_REQUIRED
+
+
+class TestRequestorCanary:
+    """canaryDomains gates the maintenance HANDOFF (review gap: the
+    gate existed only in-place — a consumer switching modes silently
+    lost canary protection).  Unit accounting mirrors in-place: fresh
+    units charge the budget, participating units keep flowing, a
+    failed canary freezes all further handoffs."""
+
+    def _policy(self, canary=1):
+        return UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=None,
+            drain_spec=DrainSpec(enable=True, force=True),
+            canary_domains=canary,
+        )
+
+    def test_canary_caps_handoffs_then_opens_fleet(self, cluster, fleet):
+        for i in range(4):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager, requestor = make_requestor_manager(cluster)
+        mop = FakeMaintenanceOperator(cluster)
+        policy = self._policy(canary=1)
+
+        reconcile(manager, fleet, policy)  # classify
+        reconcile(manager, fleet, policy)  # handoff pass
+        in_maint = [
+            n for n in ("n0", "n1", "n2", "n3")
+            if fleet.node_state(n)
+            == consts.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED
+        ]
+        assert len(in_maint) == 1, (
+            f"canary=1 must hand off exactly one node, got {in_maint}"
+        )
+        # drive the canary node to done; the fleet must then open
+        for _ in range(12):
+            mop.reconcile()
+            reconcile(manager, fleet, policy)
+            states = {n: fleet.node_state(n) for n in ("n0", "n1", "n2", "n3")}
+            if sum(
+                1
+                for s in states.values()
+                if s == consts.UPGRADE_STATE_DONE
+            ) >= 1 and sum(
+                1
+                for s in states.values()
+                if s == consts.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED
+            ) >= 1:
+                break
+        done = [n for n in states if states[n] == consts.UPGRADE_STATE_DONE]
+        assert done, f"canary never finished: {states}"
+        handed_off_after = [
+            n
+            for n in states
+            if states[n]
+            not in ("", consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        ]
+        assert len(handed_off_after) >= 2, (
+            f"fleet never opened after canary success: {states}"
+        )
+
+    def test_failed_canary_freezes_handoffs(self, cluster, fleet):
+        for i in range(3):
+            fleet.add_node(f"n{i}", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        manager, requestor = make_requestor_manager(cluster)
+        policy = self._policy(canary=1)
+
+        reconcile(manager, fleet, policy)
+        reconcile(manager, fleet, policy)
+        canary_node = next(
+            n for n in ("n0", "n1", "n2")
+            if fleet.node_state(n)
+            == consts.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED
+        )
+        # the canary fails (e.g. driver pod crashloop post-maintenance)
+        cluster.patch(
+            "Node",
+            canary_node,
+            {
+                "metadata": {
+                    "labels": {
+                        util.get_upgrade_state_label_key():
+                            consts.UPGRADE_STATE_FAILED
+                    }
+                }
+            },
+        )
+        for _ in range(3):
+            reconcile(manager, fleet, policy)
+        frozen = [
+            n for n in ("n0", "n1", "n2")
+            if n != canary_node
+            and fleet.node_state(n) == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        ]
+        assert len(frozen) == 2, (
+            "a failed canary must freeze all further handoffs: "
+            f"{[fleet.node_state(n) for n in ('n0', 'n1', 'n2')]}"
+        )
+
+
+class TestRequestorQuarantine:
+    """quarantineDegraded bars the maintenance handoff too: handing a
+    degraded slice to the maintenance operator starts exactly the
+    disruption the quarantine exists to prevent."""
+
+    def test_quarantined_node_not_handed_off(self, cluster, fleet):
+        fleet.add_node("healthy", pod_hash="rev1")
+        fleet.add_node("sick", pod_hash="rev1")
+        fleet.publish_new_revision("rev2")
+        cluster.patch(
+            "Node",
+            "sick",
+            {
+                "metadata": {
+                    "annotations": {
+                        util.get_quarantine_annotation_key(): "degraded"
+                    }
+                }
+            },
+        )
+        manager, requestor = make_requestor_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            drain_spec=DrainSpec(enable=True, force=True),
+            quarantine_degraded=True,
+        )
+        reconcile(manager, fleet, policy)  # classify
+        reconcile(manager, fleet, policy)  # handoff pass
+        assert (
+            fleet.node_state("healthy")
+            == consts.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED
+        )
+        assert (
+            fleet.node_state("sick") == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        )
+        assert requestor.get_node_maintenance_obj("sick") is None
