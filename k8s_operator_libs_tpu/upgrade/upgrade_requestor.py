@@ -37,8 +37,10 @@ from ..cluster.errors import AlreadyExistsError, NotFoundError
 from ..cluster.client import ClusterClient
 from ..cluster.inmem import JsonObj, WatchEvent
 from ..cluster.objects import name_of
+from ..tpu import topology
 from . import consts, schedule, util
 from .common_manager import ClusterUpgradeState, CommonUpgradeManager, NodeUpgradeState
+from .upgrade_inplace import canary_budget, quarantined_domains
 
 logger = logging.getLogger(__name__)
 
@@ -346,19 +348,21 @@ class RequestorNodeStateManager:
         # stamps + state buckets): ride the same budget as in-place.
         canary_remaining: Optional[int] = None
         participating: set = set()
-        quarantined = None
-        if policy is not None:
-            if policy.canary_domains > 0:
-                from .upgrade_inplace import canary_budget
-
-                canary_remaining, stamped = canary_budget(state, policy)
-                participating = set(stamped)
-            if policy.quarantine_degraded:
-                from .upgrade_inplace import quarantined_domains
-
-                quarantined = quarantined_domains(state, policy)
-        if canary_remaining is not None or quarantined:
-            from ..tpu import topology
+        if policy.canary_domains > 0:
+            canary_remaining, stamped = canary_budget(state, policy)
+            participating = set(stamped)
+        quarantined = quarantined_domains(state, policy)
+        # Quarantine bars STARTING a degraded domain; a domain already
+        # mid-handoff still finishes (stranding it half-upgraded is
+        # worse) — the in-place `fresh` exemption, same contract.
+        active_domains: set = set()
+        if quarantined:
+            active_domains = {
+                topology.domain_of(ns.node)
+                for bucket, nss in state.node_states.items()
+                if bucket in consts.ACTIVE_STATES
+                for ns in nss
+            }
         # The window gates only the NodeMaintenance HANDOFF — the
         # upgrade-requested annotation housekeeping the reference performs
         # in ProcessUpgradeRequiredNodes (:283-296) runs regardless, so a
@@ -390,7 +394,8 @@ class RequestorNodeStateManager:
             # (in-place parity: a node another gate denies must not
             # spend a budget it never used).
             if quarantined:
-                if topology.domain_of(node) in quarantined:
+                domain = topology.domain_of(node)
+                if domain in quarantined and domain not in active_domains:
                     logger.info(
                         "node %s: domain quarantined (degraded TPU) — "
                         "maintenance handoff withheld",

@@ -923,3 +923,68 @@ class TestRequestorQuarantine:
             fleet.node_state("sick") == consts.UPGRADE_STATE_UPGRADE_REQUIRED
         )
         assert requestor.get_node_maintenance_obj("sick") is None
+
+
+class TestRequestorQuarantineStraggler:
+    """Review regression (in-place `fresh` exemption parity): a domain
+    already mid-handoff finishes even if it becomes quarantined —
+    stranding a slice half-upgraded is worse than finishing it."""
+
+    def test_active_domain_straggler_still_handed_off(self, cluster, fleet):
+        slice_key = consts.SLICE_ID_LABEL_KEYS[0]
+        for name in ("s0-a", "s0-b"):
+            fleet.add_node(
+                name, pod_hash="rev1", labels={slice_key: "slice-0"}
+            )
+        fleet.publish_new_revision("rev2")
+        manager, requestor = make_requestor_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            drain_spec=DrainSpec(enable=True, force=True),
+            quarantine_degraded=True,
+            slice_aware=True,
+        )
+        reconcile(manager, fleet, policy)  # classify
+        # hand off ONE member, then quarantine the domain mid-flight
+        cluster.patch(
+            "Node", "s0-b",
+            {"metadata": {"labels": {
+                util.get_upgrade_state_label_key():
+                    consts.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED}}},
+        )
+        cluster.patch(
+            "Node", "s0-a",
+            {"metadata": {"annotations": {
+                util.get_quarantine_annotation_key(): "degraded"}}},
+        )
+        reconcile(manager, fleet, policy)
+        # the straggler of the ACTIVE domain is still handed off
+        assert (
+            fleet.node_state("s0-a")
+            == consts.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED
+        ), fleet.node_state("s0-a")
+
+    def test_fresh_quarantined_domain_still_blocked(self, cluster, fleet):
+        slice_key = consts.SLICE_ID_LABEL_KEYS[0]
+        fleet.add_node("q-a", pod_hash="rev1",
+                       labels={slice_key: "slice-q"})
+        fleet.publish_new_revision("rev2")
+        cluster.patch(
+            "Node", "q-a",
+            {"metadata": {"annotations": {
+                util.get_quarantine_annotation_key(): "degraded"}}},
+        )
+        manager, requestor = make_requestor_manager(cluster)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            drain_spec=DrainSpec(enable=True, force=True),
+            quarantine_degraded=True,
+            slice_aware=True,
+        )
+        reconcile(manager, fleet, policy)
+        reconcile(manager, fleet, policy)
+        assert (
+            fleet.node_state("q-a") == consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        )

@@ -171,3 +171,40 @@ class TestClusterEventRecorder:
         r = util.ClusterEventRecorder(ExplodingCluster())
         r.event("node-1", "Normal", "Cordon", "msg")  # must not raise
         assert r.messages() == ["msg"]  # in-process record survives
+
+
+class TestObjectPredicates:
+    """cluster/objects.py predicate helpers (reference:
+    validation_manager.go:118-136, common_manager.go:636-648)."""
+
+    def test_pod_is_ready_requires_running_and_ready_condition(self):
+        from k8s_operator_libs_tpu.cluster.objects import pod_is_ready
+
+        pod = {"status": {"phase": "Running",
+                          "conditions": [{"type": "Ready",
+                                          "status": "True"}]}}
+        assert pod_is_ready(pod) is True
+        pod["status"]["conditions"][0]["status"] = "False"
+        assert pod_is_ready(pod) is False
+        pod["status"]["phase"] = "Pending"
+        assert pod_is_ready(pod) is False
+        assert pod_is_ready({"status": {"phase": "Running"}}) is False
+
+    def test_pod_restart_count_is_max_across_containers(self):
+        from k8s_operator_libs_tpu.cluster.objects import pod_restart_count
+
+        pod = {"status": {"containerStatuses": [
+            {"restartCount": 2}, {"restartCount": 11}, {}]}}
+        assert pod_restart_count(pod) == 11
+        assert pod_restart_count({}) == 0
+
+    def test_get_condition_lookup(self):
+        from k8s_operator_libs_tpu.cluster.objects import get_condition
+
+        obj = {"status": {"conditions": [
+            {"type": "Ready", "status": "True"},
+            {"type": "Degraded", "status": "False"},
+        ]}}
+        assert get_condition(obj, "Degraded")["status"] == "False"
+        assert get_condition(obj, "Absent") is None
+        assert get_condition({}, "Ready") is None
