@@ -11,7 +11,7 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 
 .PHONY: all test test-fast lint bench smoke graft-check cov cov-report clean \
 	help image .build-image kind-e2e kind-e2e-stub tpu-smoke tpu-probe \
-	tpu-watch tpu-stage
+	tpu-watch tpu-stage verify-obs
 
 # Enforced coverage floor (VERDICT r4 next #6).  Full-suite line
 # coverage measured by the zero-dependency sys.monitoring tracer
@@ -25,13 +25,25 @@ all: lint test
 help:
 	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort -u
 
-# Full suite (control plane + TPU integration on the virtual CPU mesh).
+# Full suite (control plane + TPU integration on the virtual CPU mesh),
+# plus the observability smoke (the tracing pipeline must keep exporting
+# valid Chrome/OTLP dumps — see docs/observability.md).
 test:
 	$(PYTHON) -m pytest tests/ -q
+	$(PYTHON) -m k8s_operator_libs_tpu traces --selftest
 
 # Control-plane only (skips jax-heavy specs); fast inner loop.
 test-fast:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_tpu_integration.py
+
+# Observability gate: the tier-1 suite (same pytest invocation shape as
+# ROADMAP.md's verify command — '-m not slow' deselects nothing today
+# but keeps the two commands in lockstep if slow marks appear) plus the
+# tracing selftest (spans, W3C propagation, Chrome + OTLP exporters,
+# log injection).
+verify-obs:
+	$(PYTHON) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors
+	$(PYTHON) -m k8s_operator_libs_tpu traces --selftest
 
 lint:
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu examples bench.py __graft_entry__.py

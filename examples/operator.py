@@ -131,13 +131,21 @@ def run_real(args) -> int:
     ops = None
     if args.ops_port is not None:
         from k8s_operator_libs_tpu.controller import OpsServer
+        from k8s_operator_libs_tpu.obs import tracing
 
+        # every log record carries the current reconcile's trace id (or
+        # "-"), correlating log lines with /debug/traces and the
+        # histogram exemplars — see docs/observability.md
+        tracing.install_trace_logging()
         ops = OpsServer(port=args.ops_port, host=args.ops_host).start()
         ops.add_health_check("controller", runnable.running)
         # A hot HA standby is READY (it serves its purpose: being able
         # to take over); readiness only fails when threads died.
         ops.add_ready_check("replica", runnable.running)
-        print(f"ops endpoints on {ops.url} (/metrics /healthz /readyz)")
+        print(
+            f"ops endpoints on {ops.url} "
+            "(/metrics /healthz /readyz /debug/traces)"
+        )
     started = False
     try:
         runnable.start()

@@ -21,6 +21,15 @@ Subcommands:
       python -m k8s_operator_libs_tpu plan --state-file /tmp/cluster.json \\
           --policy fleet-policy --cycles 5
       python -m k8s_operator_libs_tpu plan --kubeconfig --policy fleet-policy
+
+* ``traces`` — pretty-print (or re-export) a reconcile trace dump saved
+  from the operator's ``GET /debug/traces`` endpoint (any of the three
+  formats it serves), or run the tracing pipeline selftest.
+
+      curl $OPS/debug/traces > traces.json
+      python -m k8s_operator_libs_tpu traces --file traces.json
+      python -m k8s_operator_libs_tpu traces --file traces.json --fmt chrome
+      python -m k8s_operator_libs_tpu traces --selftest
 """
 
 from __future__ import annotations
@@ -337,7 +346,7 @@ def cmd_history(args: argparse.Namespace) -> int:
     cluster, rc = _open_source(args, "history")
     if cluster is None:
         return rc
-    from .cluster.errors import ApiError
+    from .cluster.errors import ApiError, NotFoundError
     from .upgrade.history import node_event_history, render_history
 
     try:
@@ -349,6 +358,11 @@ def cmd_history(args: argparse.Namespace) -> int:
             ),
             component=args.source or None,
         )
+    except NotFoundError:
+        # --node names a node the source has never heard of: a typo, not
+        # an empty timeline (exit 3 = "queried thing absent", as repair).
+        print(f"node {args.node} not found in the source", file=sys.stderr)
+        return 3
     except (ApiError, OSError) as err:
         print(f"cannot read events: {err}", file=sys.stderr)
         return 2
@@ -356,6 +370,55 @@ def cmd_history(args: argparse.Namespace) -> int:
         print(json.dumps([e.to_dict() for e in entries]))
     else:
         print(render_history(entries))
+    return 0
+
+
+def cmd_traces(args: argparse.Namespace) -> int:
+    """Pretty-print / re-export a trace dump, or run the selftest smoke
+    (``make verify-obs`` gates on the latter)."""
+    from .obs import tracing
+
+    if args.selftest:
+        try:
+            print(tracing.selftest())
+        except AssertionError as err:
+            print(f"traces selftest FAILED: {err}", file=sys.stderr)
+            return 1
+        return 0
+    if not args.file:
+        print("traces needs --file DUMP (or --selftest)", file=sys.stderr)
+        return 2
+    try:
+        with open(args.file, "r", encoding="utf-8") as fh:
+            payload = json.load(fh)
+        traces = tracing.traces_from_payload(payload)
+    except FileNotFoundError:
+        print(f"trace file not found: {args.file}", file=sys.stderr)
+        return 2
+    except OSError as err:
+        # directory / permission denied / IO error — same clean exit as
+        # the other subcommands' source-open failures
+        print(f"cannot read trace file {args.file}: {err}", file=sys.stderr)
+        return 2
+    except (json.JSONDecodeError, ValueError, TypeError, KeyError) as err:
+        print(f"trace file {args.file} is not a trace dump: {err}", file=sys.stderr)
+        return 2
+    if args.trace_id:
+        traces = [t for t in traces if t.get("trace_id") == args.trace_id]
+        if not traces:
+            print(f"trace {args.trace_id} not in dump", file=sys.stderr)
+            return 3
+    if args.fmt == "chrome":
+        print(json.dumps(tracing.to_chrome(traces)))
+    elif args.fmt == "otlp":
+        print(json.dumps(tracing.to_otlp(traces)))
+    elif args.json:
+        print(json.dumps({"traces": traces}))
+    else:
+        for i, trace in enumerate(traces):
+            if i:
+                print()
+            print(tracing.render_trace_tree(trace))
     return 0
 
 
@@ -608,6 +671,42 @@ def main(argv=None) -> int:
         "the pure upgrade timeline (default: all components)",
     )
     hi.set_defaults(func=cmd_history)
+
+    tr = sub.add_parser(
+        "traces",
+        help="pretty-print or re-export a reconcile trace dump saved from "
+        "the operator's /debug/traces endpoint; --selftest smokes the "
+        "tracing pipeline",
+    )
+    tr.add_argument(
+        "--file",
+        default="",
+        help="trace dump JSON (native, OTLP-flavoured, or Chrome — the "
+        "three shapes /debug/traces serves)",
+    )
+    tr.add_argument(
+        "--trace-id", default="", help="only this trace from the dump"
+    )
+    tr.add_argument(
+        "--fmt",
+        choices=("tree", "chrome", "otlp"),
+        default="tree",
+        help="output: human span tree (default), chrome://tracing JSON, "
+        "or OTLP-flavoured JSON",
+    )
+    tr.add_argument(
+        "--json",
+        action="store_true",
+        help="machine output (native trace dicts; with --fmt chrome/otlp "
+        "the output is already JSON)",
+    )
+    tr.add_argument(
+        "--selftest",
+        action="store_true",
+        help="run the tracing pipeline end-to-end (spans, propagation, "
+        "both exporters) and exit 0/1 — the make verify-obs smoke",
+    )
+    tr.set_defaults(func=cmd_traces)
 
     rp = sub.add_parser(
         "repair",

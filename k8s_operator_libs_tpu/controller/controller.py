@@ -37,6 +37,7 @@ from typing import Callable, Hashable, Iterable, List, Optional, Protocol
 from ..cluster.errors import ExpiredError
 from ..cluster.client import ClusterClient
 from ..cluster.inmem import JsonObj, WatchEvent
+from ..obs import tracing
 from .workqueue import RateLimitedQueue, ShutDown
 
 logger = logging.getLogger(__name__)
@@ -335,30 +336,46 @@ class Controller:
                 return
             if request is None:
                 continue
-            try:
-                result = self._reconciler.reconcile(request)
-            except Exception as err:  # noqa: BLE001 — worker boundary
-                retries = self._queue.num_requeues(request)
-                if self._max_retries is not None and retries >= self._max_retries:
-                    logger.error(
-                        "%s: giving up on %r after %d retries: %s",
-                        self.name, request, retries, err,
-                    )
+            # The per-request root span: everything the reconciler does —
+            # BuildState, ApplyState, the per-node processors, and (via
+            # traceparent handoff) the async drain/eviction workers —
+            # nests under it, answering "where did this reconcile go?".
+            with tracing.start_span(
+                "Reconcile",
+                attributes={"controller": self.name, "request": str(request)},
+            ) as span:
+                wait = self._queue.queue_wait(request)
+                if wait is not None:
+                    span.set_attribute("queue_wait_s", round(wait, 6))
+                    # the wait PRECEDED this span; record it as an
+                    # already-elapsed child so the trace shows dequeue
+                    # latency next to the work it delayed
+                    tracing.record_span("queue-wait", wait, parent=span)
+                try:
+                    result = self._reconciler.reconcile(request)
+                except Exception as err:  # noqa: BLE001 — worker boundary
+                    span.set_status("error", str(err))
+                    retries = self._queue.num_requeues(request)
+                    if self._max_retries is not None and retries >= self._max_retries:
+                        logger.error(
+                            "%s: giving up on %r after %d retries: %s",
+                            self.name, request, retries, err,
+                        )
+                        self._queue.forget(request)
+                        self.dropped.append(request)
+                    else:
+                        logger.warning(
+                            "%s: reconcile of %r failed (retry %d): %s",
+                            self.name, request, retries + 1, err,
+                        )
+                        self._queue.add_rate_limited(request)
+                    self._queue.done(request)
+                    continue
+                if result is not None and result.requeue_after > 0:
                     self._queue.forget(request)
-                    self.dropped.append(request)
-                else:
-                    logger.warning(
-                        "%s: reconcile of %r failed (retry %d): %s",
-                        self.name, request, retries + 1, err,
-                    )
+                    self._queue.add_after(request, result.requeue_after)
+                elif result is not None and result.requeue:
                     self._queue.add_rate_limited(request)
+                else:
+                    self._queue.forget(request)
                 self._queue.done(request)
-                continue
-            if result is not None and result.requeue_after > 0:
-                self._queue.forget(request)
-                self._queue.add_after(request, result.requeue_after)
-            elif result is not None and result.requeue:
-                self._queue.add_rate_limited(request)
-            else:
-                self._queue.forget(request)
-            self._queue.done(request)

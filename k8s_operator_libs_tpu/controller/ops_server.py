@@ -1,4 +1,4 @@
-"""Operational HTTP endpoints: /metrics, /healthz, /readyz.
+"""Operational HTTP endpoints: /metrics, /healthz, /readyz, /debug/traces.
 
 The reference's host operators inherit these from the controller-runtime
 manager, which serves Prometheus metrics on ``:8080/metrics`` and
@@ -14,7 +14,17 @@ server exposing
   (kubelet restarts the pod on failure);
 * ``GET /readyz``   — readiness: every registered ready check must pass
   (the Service stops routing on failure; a hot HA standby is LIVE but
-  whether it reports READY is the consumer's choice of check).
+  whether it reports READY is the consumer's choice of check);
+* ``GET /debug/traces`` — recent completed reconcile traces from the
+  process tracer (:mod:`..obs.tracing`), OTLP-flavoured JSON by default;
+  ``?fmt=chrome`` renders ``chrome://tracing`` JSON, ``?fmt=native`` the
+  raw span dicts, ``?trace_id=...`` filters to one trace.
+
+``/metrics`` also honors ``Accept: application/openmetrics-text`` with
+the OpenMetrics rendering, whose histogram ``+Inf`` bucket lines carry
+trace-ID exemplars — the metrics↔traces correlation hook.  ``HEAD`` is
+answered for every endpoint (status + headers, no body — some probe
+fleets use it).
 
 Checks are ``name -> callable`` returning True/None on success; a check
 that returns False or raises fails the probe, and the response body
@@ -24,12 +34,15 @@ format).  Failures answer 500 so kubelet/Service probes act on them.
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs
 
 from .. import metrics as metrics_mod
+from ..obs import tracing as tracing_mod
 
 logger = logging.getLogger(__name__)
 
@@ -59,6 +72,7 @@ class OpsServer:
         port: int = 8080,
         host: str = "0.0.0.0",
         registry: Optional[metrics_mod.MetricsRegistry] = None,
+        tracer: Optional[tracing_mod.Tracer] = None,
     ) -> None:
         # All-interfaces default, like controller-runtime's metrics/probe
         # listeners: kubelet probes and Prometheus scrapes arrive on the
@@ -66,6 +80,7 @@ class OpsServer:
         self._host = host
         self._requested_port = port
         self._registry = registry
+        self._tracer = tracer
         self._health_checks: Dict[str, Check] = {}
         self._ready_checks: Dict[str, Check] = {}
         self._lock = threading.Lock()
@@ -118,6 +133,58 @@ class OpsServer:
         host = "127.0.0.1" if self._host in ("0.0.0.0", "::") else self._host
         return f"http://{host}:{self.port}"
 
+    # ----------------------------------------------------------- dispatch
+    def _render_traces(self, query: Dict[str, list]) -> Tuple[int, str, bytes]:
+        tracer = self._tracer or tracing_mod.default_tracer()
+        trace_id = (query.get("trace_id") or [""])[0]
+        if trace_id:
+            trace = tracer.get_trace(trace_id)
+            traces = [] if trace is None else [trace]
+        else:
+            traces = tracer.traces()
+        fmt = (query.get("fmt") or ["otlp"])[0]
+        if fmt == "chrome":
+            payload = tracing_mod.to_chrome(traces)
+        elif fmt == "native":
+            payload = {"traces": traces}
+        elif fmt == "otlp":
+            payload = tracing_mod.to_otlp(traces)
+        else:
+            return (
+                400,
+                "text/plain; charset=utf-8",
+                f"unknown fmt {fmt!r} (want otlp | chrome | native)\n".encode(),
+            )
+        return 200, "application/json", (json.dumps(payload) + "\n").encode()
+
+    def _respond(
+        self, raw_path: str, accept: str = ""
+    ) -> Tuple[int, str, bytes]:
+        """(status, content_type, body) for one request — shared by GET
+        and HEAD so both always agree on status/headers."""
+        path, _, raw_query = raw_path.partition("?")
+        if path == "/metrics":
+            reg = self._registry or metrics_mod.default_registry()
+            # Content negotiation like a real Prometheus endpoint: the
+            # OpenMetrics rendering (carrying exemplars) only when asked.
+            openmetrics = "application/openmetrics-text" in (accept or "")
+            content_type = (
+                "application/openmetrics-text; version=1.0.0; charset=utf-8"
+                if openmetrics
+                else "text/plain; version=0.0.4; charset=utf-8"
+            )
+            return 200, content_type, reg.render(openmetrics=openmetrics).encode()
+        if path in ("/healthz", "/readyz"):
+            ok, lines = self._run_checks(path.lstrip("/"))
+            return (
+                200 if ok else 500,
+                "text/plain; charset=utf-8",
+                ("\n".join(lines) + "\n").encode(),
+            )
+        if path == "/debug/traces":
+            return self._render_traces(parse_qs(raw_query))
+        return 404, "text/plain; charset=utf-8", b"404 not found\n"
+
     def start(self) -> "OpsServer":
         if self._server is not None:
             raise RuntimeError("ops server already started")
@@ -127,28 +194,31 @@ class OpsServer:
             def log_message(self, fmt, *args):  # noqa: D102 — quiet
                 logger.debug("ops: " + fmt, *args)
 
-            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-                path = self.path.split("?", 1)[0]
-                if path == "/metrics":
-                    reg = ops._registry or metrics_mod.default_registry()
-                    body = reg.render().encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type",
-                        "text/plain; version=0.0.4; charset=utf-8",
+            def _serve(self, include_body: bool) -> None:
+                try:
+                    status, ctype, body = ops._respond(
+                        self.path, self.headers.get("Accept", "")
                     )
-                elif path in ("/healthz", "/readyz"):
-                    ok, lines = ops._run_checks(path.lstrip("/"))
-                    body = ("\n".join(lines) + "\n").encode()
-                    self.send_response(200 if ok else 500)
-                    self.send_header("Content-Type", "text/plain; charset=utf-8")
-                else:
-                    body = b"404 not found\n"
-                    self.send_response(404)
-                    self.send_header("Content-Type", "text/plain; charset=utf-8")
+                except Exception as err:  # noqa: BLE001 — handler boundary
+                    logger.error("ops: %s failed: %s", self.path, err)
+                    status, ctype, body = (
+                        500,
+                        "text/plain; charset=utf-8",
+                        b"internal error\n",
+                    )
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
-                self.wfile.write(body)
+                if include_body:
+                    self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                self._serve(include_body=True)
+
+            def do_HEAD(self):  # noqa: N802 — probes that HEAD first must
+                # get real status + headers, not a 501 (and no body)
+                self._serve(include_body=False)
 
         self._server = ThreadingHTTPServer((self._host, self._requested_port), Handler)
         self._server.daemon_threads = True

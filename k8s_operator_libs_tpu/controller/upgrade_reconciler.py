@@ -24,6 +24,7 @@ from ..api.upgrade_spec import UpgradePolicySpec, ValidationError
 from ..cluster.errors import NotFoundError
 from ..cluster.client import ClusterClient
 from ..cluster.inmem import JsonObj
+from ..obs import tracing
 from ..upgrade.upgrade_state import ClusterUpgradeStateManager
 from .controller import Controller, Result
 
@@ -131,6 +132,18 @@ class UpgradeReconciler:
             return None
         self.manager.apply_state(state, policy)
         common = self.manager.common
+        # Census onto the controller's Reconcile root span (when one is
+        # open): /debug/traces then shows WHY each cycle chose its
+        # requeue cadence without cross-referencing the gauges.
+        span = tracing.current_span()
+        if span is not None:
+            span.set_attribute(
+                "in_progress", common.get_upgrades_in_progress(state)
+            )
+            span.set_attribute("pending", common.get_upgrades_pending(state))
+            span.set_attribute(
+                "transitions", self.manager.last_apply_transitions
+            )
         if common.get_upgrades_in_progress(state):
             return Result(requeue_after=self.active_requeue_seconds)
         if self.manager.last_apply_transitions:

@@ -46,6 +46,12 @@ class WorkQueue:
         self._processing: Set[Hashable] = set()
         self._dirty: Set[Hashable] = set()
         self._shutting_down = False
+        # queue-wait attribution (observability): when each queued item
+        # was enqueued, and — while an item is being processed — how long
+        # it sat queued before get() handed it out (the "queue-wait" span
+        # on the reconcile trace).
+        self._enqueued_at: Dict[Hashable, float] = {}
+        self._last_wait: Dict[Hashable, float] = {}
 
     def add(self, item: Hashable) -> None:
         with self._cond:
@@ -58,6 +64,7 @@ class WorkQueue:
                 return
             self._queued.add(item)
             self._queue.append(item)
+            self._enqueued_at[item] = time.monotonic()
             self._cond.notify()
 
     def get(self, timeout: Optional[float] = None) -> Optional[Hashable]:
@@ -78,17 +85,29 @@ class WorkQueue:
             item = self._queue.pop(0)
             self._queued.discard(item)
             self._processing.add(item)
+            enqueued = self._enqueued_at.pop(item, None)
+            if enqueued is not None:
+                self._last_wait[item] = time.monotonic() - enqueued
             return item
+
+    def queue_wait(self, item: Hashable) -> Optional[float]:
+        """Seconds *item* sat queued before the get() that handed it to
+        the current processor; None when unknown.  Valid between get()
+        and done() — the window the worker's reconcile span is open."""
+        with self._cond:
+            return self._last_wait.get(item)
 
     def done(self, item: Hashable) -> None:
         """Mark processing finished; a dirty item goes straight back in."""
         with self._cond:
             self._processing.discard(item)
+            self._last_wait.pop(item, None)
             if item in self._dirty:
                 self._dirty.discard(item)
                 if not self._shutting_down and item not in self._queued:
                     self._queued.add(item)
                     self._queue.append(item)
+                    self._enqueued_at[item] = time.monotonic()
                     self._cond.notify()
             elif self._shutting_down and not self._processing:
                 self._cond.notify_all()

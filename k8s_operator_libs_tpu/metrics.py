@@ -27,6 +27,7 @@ same way the reference stays logr-only.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 _PREFIX = "k8s_operator_libs_tpu_"
@@ -79,13 +80,14 @@ class _Metric:
             )
         return tuple(str(v) for v in labels)
 
-    def render(self) -> List[str]:  # pragma: no cover — overridden
+    def render(self, openmetrics: bool = False) -> List[str]:  # pragma: no cover — overridden
         raise NotImplementedError
 
-    def _header(self) -> List[str]:
+    def _header(self, family: Optional[str] = None) -> List[str]:
+        name = family or self.name
         return [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} {self.kind}",
+            f"# HELP {name} {self.help}",
+            f"# TYPE {name} {self.kind}",
         ]
 
 
@@ -110,13 +112,27 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
-    def render(self) -> List[str]:
+    def render(self, openmetrics: bool = False) -> List[str]:
         with self._lock:
             items = sorted(self._values.items())
-        lines = self._header()
+        if openmetrics:
+            # OpenMetrics counter contract: the FAMILY name carries no
+            # _total (HELP/TYPE lines), every sample carries it.  A
+            # family named *_total with *_total samples is a "clashing
+            # name" to strict parsers, which then reject the whole
+            # scrape — not just this metric.
+            family = (
+                self.name[: -len("_total")]
+                if self.name.endswith("_total")
+                else self.name
+            )
+            sample = family + "_total"
+        else:
+            family = sample = self.name
+        lines = self._header(family)
         for labels, v in items:
             lines.append(
-                f"{self.name}{_format_labels(self.labelnames, labels)} "
+                f"{sample}{_format_labels(self.labelnames, labels)} "
                 f"{_format_value(v)}"
             )
         return lines
@@ -164,7 +180,7 @@ class Gauge(_Metric):
         with self._lock:
             self._values = checked
 
-    def render(self) -> List[str]:
+    def render(self, openmetrics: bool = False) -> List[str]:
         with self._lock:
             items = sorted(self._values.items())
         lines = self._header()
@@ -199,8 +215,17 @@ class Histogram(_Metric):
             raise ValueError("histogram needs at least one finite bucket")
         # per-labelset: (bucket counts, total count, sum)
         self._series: Dict[LabelValues, Tuple[List[int], int, float]] = {}
+        # per-labelset: last exemplar (labels dict, observed value, unix ts)
+        # — the OpenMetrics trace-correlation hook (a Prometheus exemplar
+        # keeps the LAST observation per series the same way)
+        self._exemplars: Dict[LabelValues, Tuple[Dict[str, str], float, float]] = {}
 
-    def observe(self, value: float, *labels: str) -> None:
+    def observe(
+        self,
+        value: float,
+        *labels: str,
+        exemplar: Optional[Dict[str, str]] = None,
+    ) -> None:
         key = self._check(tuple(labels))
         with self._lock:
             counts, count, total = self._series.get(
@@ -211,6 +236,21 @@ class Histogram(_Metric):
                 if value <= bound:
                     counts[i] += 1
             self._series[key] = (counts, count + 1, total + float(value))
+            if exemplar:
+                self._exemplars[key] = (
+                    {str(k): str(v) for k, v in exemplar.items()},
+                    float(value),
+                    time.time(),
+                )
+
+    def exemplar(
+        self, *labels: str
+    ) -> Optional[Tuple[Dict[str, str], float, float]]:
+        """The series' most recent exemplar as ``(labels, value, unix_ts)``
+        — e.g. ``({"trace_id": ...}, 38.2, 1767...)`` — or None."""
+        key = self._check(tuple(labels))
+        with self._lock:
+            return self._exemplars.get(key)
 
     def count(self, *labels: str) -> int:
         key = self._check(tuple(labels))
@@ -222,13 +262,28 @@ class Histogram(_Metric):
         with self._lock:
             return self._series.get(key, ([], 0, 0.0))[2]
 
-    def render(self) -> List[str]:
+    def render(self, openmetrics: bool = False) -> List[str]:
         with self._lock:
             items = sorted(
                 (k, (list(c), n, s)) for k, (c, n, s) in self._series.items()
             )
+            exemplars = dict(self._exemplars) if openmetrics else {}
         lines = self._header()
         for labels, (counts, count, total) in items:
+            # Exemplars are OpenMetrics-only syntax — the 0.0.4 exposition
+            # this registry serves by default must stay parseable by strict
+            # scrapers, so they ride the +Inf bucket line only when the
+            # consumer asked for the OpenMetrics rendering.
+            exemplar_suffix = ""
+            hit = exemplars.get(labels)
+            if hit is not None:
+                ex_labels, ex_value, ex_ts = hit
+                pairs = ",".join(
+                    f'{k}="{_escape(v)}"' for k, v in sorted(ex_labels.items())
+                )
+                exemplar_suffix = (
+                    f" # {{{pairs}}} {_format_value(ex_value)} {ex_ts:.3f}"
+                )
             for bound, c in zip(self.buckets, counts):
                 le = _format_value(bound)
                 lines.append(
@@ -237,7 +292,8 @@ class Histogram(_Metric):
                 )
             lines.append(
                 f"{self.name}_bucket"
-                f"{_format_labels(self.labelnames, labels, ('le', '+Inf'))} {count}"
+                f"{_format_labels(self.labelnames, labels, ('le', '+Inf'))} "
+                f"{count}{exemplar_suffix}"
             )
             lines.append(
                 f"{self.name}_sum{_format_labels(self.labelnames, labels)} "
@@ -307,11 +363,16 @@ class MetricsRegistry:
         with self._lock:
             return list(self._metrics.values())
 
-    def render(self) -> str:
-        """The full registry in Prometheus text exposition format 0.0.4."""
+    def render(self, openmetrics: bool = False) -> str:
+        """The full registry in Prometheus text exposition format 0.0.4 —
+        or, with *openmetrics*, the OpenMetrics rendering that carries
+        histogram exemplars (trace-ID correlation) and the ``# EOF``
+        terminator."""
         lines: List[str] = []
         for metric in sorted(self.collect(), key=lambda m: m.name):
-            lines.extend(metric.render())
+            lines.extend(metric.render(openmetrics=openmetrics))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -346,22 +407,32 @@ def record_state_transition(to_state: str) -> None:
     ).inc(to_state or "unknown")
 
 
-def observe_reconcile(phase: str, seconds: float) -> None:
+def observe_reconcile(
+    phase: str, seconds: float, trace_id: Optional[str] = None
+) -> None:
     default_registry().histogram(
         "reconcile_seconds",
         "Duration of state-machine phases (build_state / apply_state).",
         ("phase",),
-    ).observe(seconds, phase)
+    ).observe(
+        seconds,
+        phase,
+        exemplar={"trace_id": trace_id} if trace_id else None,
+    )
 
 
-def record_drain(result: str, seconds: float) -> None:
+def record_drain(
+    result: str, seconds: float, trace_id: Optional[str] = None
+) -> None:
     reg = default_registry()
     reg.counter(
         "drains_total", "Completed node drains, by result.", ("result",)
     ).inc(result)
     reg.histogram(
         "drain_seconds", "Wall-clock duration of node drains."
-    ).observe(seconds)
+    ).observe(
+        seconds, exemplar={"trace_id": trace_id} if trace_id else None
+    )
 
 
 def publish_rollout_gauges(

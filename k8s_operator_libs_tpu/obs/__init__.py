@@ -1,0 +1,49 @@
+"""Observability surfaces that sit NEXT to the control plane.
+
+The reference library is logr-only (SURVEY.md §5 — even its one
+aggregate-progress event is commented out); this package holds the
+signals this reproduction grew beyond it: :mod:`.tracing` (in-process
+spans + W3C traceparent propagation + Chrome/OTLP exporters).  Metrics
+live in :mod:`..metrics` (predating this package); the HTTP surface for
+both is :class:`~..controller.ops_server.OpsServer`.
+"""
+
+from .tracing import (
+    Span,
+    TraceContextFilter,
+    Tracer,
+    current_span,
+    current_trace_id,
+    current_traceparent,
+    default_tracer,
+    format_traceparent,
+    install_trace_logging,
+    parse_traceparent,
+    record_span,
+    render_trace_tree,
+    set_default_tracer,
+    start_span,
+    to_chrome,
+    to_otlp,
+    traces_from_payload,
+)
+
+__all__ = [
+    "Span",
+    "TraceContextFilter",
+    "Tracer",
+    "current_span",
+    "current_trace_id",
+    "current_traceparent",
+    "default_tracer",
+    "format_traceparent",
+    "install_trace_logging",
+    "parse_traceparent",
+    "record_span",
+    "render_trace_tree",
+    "set_default_tracer",
+    "start_span",
+    "to_chrome",
+    "to_otlp",
+    "traces_from_payload",
+]

@@ -34,6 +34,7 @@ from ..cluster.errors import NotFoundError
 from ..cluster.client import ClusterClient
 from ..cluster.inmem import JsonObj
 from ..cluster.objects import get_annotation, name_of
+from ..obs import tracing
 from ..upgrade import consts, util
 
 logger = logging.getLogger(__name__)
@@ -61,42 +62,63 @@ class CheckpointDrainGate:
             return
         name = name_of(node)
         key = util.get_pre_drain_checkpoint_annotation_key()
+        tp_key = util.get_pre_drain_traceparent_annotation_key()
         # Per-cycle token: the ack must echo it, so a laggard "done" from a
         # previous timed-out cycle can never satisfy this cycle's gate.
         token = uuid.uuid4().hex[:12]
         requested = f"{consts.PRE_DRAIN_CHECKPOINT_REQUESTED}:{token}"
         expected_ack = f"{consts.PRE_DRAIN_CHECKPOINT_DONE}:{token}"
-        self._cluster.patch(
-            "Node",
-            name,
-            {"metadata": {"annotations": {key: requested}}},
-        )
-        deadline = (
-            time.monotonic() + self.spec.timeout_second
-            if self.spec.timeout_second > 0
-            else None
-        )
-        while True:
-            try:
-                current = self._cluster.get("Node", name)
-            except NotFoundError:
-                return
-            if get_annotation(current, key) == expected_ack:
-                logger.info("node %s checkpoint acknowledged before drain", name)
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                logger.warning(
-                    "node %s checkpoint wait timed out after %ss; "
-                    "draining anyway",
-                    name,
-                    self.spec.timeout_second,
-                )
-                break
-            time.sleep(self._poll)
-        # Clear the handshake so the next upgrade cycle starts fresh.
-        self._cluster.patch(
-            "Node", name, {"metadata": {"annotations": {key: None}}}
-        )
+        with tracing.start_span(
+            "drain-handshake", attributes={"node": name}
+        ) as span:
+            # The handshake payload carries the span's W3C traceparent so
+            # the workload side (another process, another tracer) parents
+            # its checkpoint-drain span under THIS wait.
+            self._cluster.patch(
+                "Node",
+                name,
+                {
+                    "metadata": {
+                        "annotations": {
+                            key: requested,
+                            tp_key: span.traceparent,
+                        }
+                    }
+                },
+            )
+            deadline = (
+                time.monotonic() + self.spec.timeout_second
+                if self.spec.timeout_second > 0
+                else None
+            )
+            while True:
+                try:
+                    current = self._cluster.get("Node", name)
+                except NotFoundError:
+                    span.set_attribute("result", "node-gone")
+                    return
+                if get_annotation(current, key) == expected_ack:
+                    logger.info(
+                        "node %s checkpoint acknowledged before drain", name
+                    )
+                    span.set_attribute("result", "acknowledged")
+                    break
+                if deadline is not None and time.monotonic() >= deadline:
+                    logger.warning(
+                        "node %s checkpoint wait timed out after %ss; "
+                        "draining anyway",
+                        name,
+                        self.spec.timeout_second,
+                    )
+                    span.set_attribute("result", "timeout")
+                    break
+                time.sleep(self._poll)
+            # Clear the handshake so the next upgrade cycle starts fresh.
+            self._cluster.patch(
+                "Node",
+                name,
+                {"metadata": {"annotations": {key: None, tp_key: None}}},
+            )
 
 
 class DrainSignalWatcher:
@@ -114,18 +136,30 @@ class DrainSignalWatcher:
         cluster: ClusterClient,
         node_name: str,
         read_annotation: Optional[Callable[[], str]] = None,
+        read_traceparent: Optional[Callable[[], str]] = None,
     ) -> None:
         self._cluster = cluster
         self.node_name = node_name
         self._key = util.get_pre_drain_checkpoint_annotation_key()
+        self._tp_key = util.get_pre_drain_traceparent_annotation_key()
         self._read = read_annotation or self._read_from_cluster
+        self._read_tp = read_traceparent or self._read_traceparent_from_cluster
 
-    def _read_from_cluster(self) -> str:
+    def _read_node_annotation(self, key: str) -> str:
+        if self._cluster is None:
+            # injected-reader assembly (downward-API file): no API access
+            return ""
         try:
             node = self._cluster.get("Node", self.node_name)
         except NotFoundError:
             return ""
-        return get_annotation(node, self._key)
+        return get_annotation(node, key)
+
+    def _read_from_cluster(self) -> str:
+        return self._read_node_annotation(self._key)
+
+    def _read_traceparent_from_cluster(self) -> str:
+        return self._read_node_annotation(self._tp_key)
 
     def checkpoint_requested(self) -> bool:
         value = self._read()
@@ -150,9 +184,18 @@ class DrainSignalWatcher:
         self, on_checkpoint: Callable[[], None]
     ) -> bool:
         """If a checkpoint was requested: run ``on_checkpoint`` (e.g. an
-        orbax save), acknowledge, and return True."""
+        orbax save), acknowledge, and return True.  The save runs under a
+        ``checkpoint-drain`` span parented (via the traceparent the gate
+        wrote next to the request) under the orchestrator's handshake
+        wait — the cross-process leg of the reconcile trace."""
         if not self.checkpoint_requested():
             return False
-        on_checkpoint()
-        self.acknowledge()
+        traceparent = self._read_tp() or None
+        with tracing.start_span(
+            "checkpoint-drain",
+            attributes={"node": self.node_name},
+            traceparent=traceparent,
+        ):
+            on_checkpoint()
+            self.acknowledge()
         return True

@@ -42,6 +42,7 @@ from ..cluster.objects import (
     owner_references,
     pod_phase,
 )
+from ..obs import tracing
 from ..tpu import topology
 from . import consts, util
 from .cordon_manager import CordonManager
@@ -271,10 +272,27 @@ class CommonUpgradeManager:
         return topology.domain_of(node) in blocked_domains
 
     # ------------------------------------------------------------- processors
+    @staticmethod
+    def _node_span(node: JsonObj, phase: str) -> tracing.Span:
+        """Per-node ``ProcessNodeState`` span — child of the enclosing
+        ApplyState span, tagged with the node and the phase bucket it was
+        processed from (the per-node latency attribution the histograms
+        cannot give)."""
+        return tracing.start_span(
+            "ProcessNodeState",
+            attributes={"node": name_of(node), "phase": phase},
+        )
+
     def process_done_or_unknown_nodes(
         self, state: ClusterUpgradeState, state_name: str
     ) -> None:
-        """Reference: ProcessDoneOrUnknownNodes (:229-291)."""
+        """Reference: ProcessDoneOrUnknownNodes (:229-291).
+
+        Tracing note: this is the one processor that scans the WHOLE
+        fleet every cycle (the steady-state done bucket), so the
+        per-node span opens only around an actual transition — an
+        always-on span per read-only check costs ~2× at 4,096 nodes for
+        spans nobody will ever look at."""
         for node_state in state.nodes_in(state_name):
             node = node_state.node
             synced, orphaned = self.pod_in_sync_with_ds(node_state)
@@ -283,30 +301,36 @@ class CommonUpgradeManager:
                 self.safe_driver_load_manager.is_waiting_for_safe_driver_load(node)
             )
             if (not synced and not orphaned) or waiting_safe_load or requested:
-                # Record pre-existing unschedulability so the final uncordon
-                # is skipped for nodes that started out cordoned (:250-264).
-                if self.is_node_unschedulable(node):
-                    self.provider.change_node_upgrade_annotation(
-                        node,
-                        util.get_upgrade_initial_state_annotation_key(),
-                        consts.TRUE_STRING,
+                with self._node_span(node, state_name or "unknown"):
+                    # Record pre-existing unschedulability so the final
+                    # uncordon is skipped for nodes that started out
+                    # cordoned (:250-264).
+                    if self.is_node_unschedulable(node):
+                        self.provider.change_node_upgrade_annotation(
+                            node,
+                            util.get_upgrade_initial_state_annotation_key(),
+                            consts.TRUE_STRING,
+                        )
+                    self.provider.change_node_upgrade_state(
+                        node, consts.UPGRADE_STATE_UPGRADE_REQUIRED
                     )
-                self.provider.change_node_upgrade_state(
-                    node, consts.UPGRADE_STATE_UPGRADE_REQUIRED
-                )
                 continue
             if state_name == consts.UPGRADE_STATE_UNKNOWN:
-                self.provider.change_node_upgrade_state(
-                    node, consts.UPGRADE_STATE_DONE
-                )
+                with self._node_span(node, state_name):
+                    self.provider.change_node_upgrade_state(
+                        node, consts.UPGRADE_STATE_DONE
+                    )
 
     def process_cordon_required_nodes(self, state: ClusterUpgradeState) -> None:
         """Reference: ProcessCordonRequiredNodes (:361-380)."""
         for node_state in state.nodes_in(consts.UPGRADE_STATE_CORDON_REQUIRED):
-            self.cordon_manager.cordon(node_state.node)
-            self.provider.change_node_upgrade_state(
-                node_state.node, consts.UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED
-            )
+            with self._node_span(
+                node_state.node, consts.UPGRADE_STATE_CORDON_REQUIRED
+            ):
+                self.cordon_manager.cordon(node_state.node)
+                self.provider.change_node_upgrade_state(
+                    node_state.node, consts.UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED
+                )
 
     def process_wait_for_jobs_required_nodes(
         self,
@@ -391,41 +415,42 @@ class CommonUpgradeManager:
         )
         for node_state in restart_bucket:
             node = node_state.node
-            synced, orphaned = self.pod_in_sync_with_ds(node_state)
-            if not synced or orphaned:
-                # Restart only pods not already terminating (:468-474).
-                if not node_state.driver_pod.get("metadata", {}).get(
-                    "deletionTimestamp"
-                ):
-                    pods_to_restart.append(node_state.driver_pod)
-                continue
-            # Slice-coherent mode: hold this host at the barrier while a
-            # slice peer is still on the old revision — deliberately held,
-            # so skip the failure check too (a held init container is not
-            # a failing driver).
-            if self.held_at_slice_load_barrier(node_state, blocked_domains):
-                continue
-            # Pod is at the right revision: release a blocked driver init
-            # container before checking readiness (:476-481).
-            self.safe_driver_load_manager.unblock_loading(node)
-            if self.is_driver_pod_in_sync(node_state):
-                if not self.is_validation_enabled():
-                    self.update_node_to_uncordon_or_done_state(node_state)
+            with self._node_span(node, consts.UPGRADE_STATE_POD_RESTART_REQUIRED):
+                synced, orphaned = self.pod_in_sync_with_ds(node_state)
+                if not synced or orphaned:
+                    # Restart only pods not already terminating (:468-474).
+                    if not node_state.driver_pod.get("metadata", {}).get(
+                        "deletionTimestamp"
+                    ):
+                        pods_to_restart.append(node_state.driver_pod)
                     continue
-                self.provider.change_node_upgrade_state(
-                    node, consts.UPGRADE_STATE_VALIDATION_REQUIRED
-                )
-            elif self.is_driver_pod_failing(node_state.driver_pod):
-                log_event(
-                    self.recorder,
-                    name_of(node),
-                    "Warning",
-                    util.get_event_reason(),
-                    "Driver pod is failing with repeated restarts",
-                )
-                self.provider.change_node_upgrade_state(
-                    node, consts.UPGRADE_STATE_FAILED
-                )
+                # Slice-coherent mode: hold this host at the barrier while a
+                # slice peer is still on the old revision — deliberately held,
+                # so skip the failure check too (a held init container is not
+                # a failing driver).
+                if self.held_at_slice_load_barrier(node_state, blocked_domains):
+                    continue
+                # Pod is at the right revision: release a blocked driver init
+                # container before checking readiness (:476-481).
+                self.safe_driver_load_manager.unblock_loading(node)
+                if self.is_driver_pod_in_sync(node_state):
+                    if not self.is_validation_enabled():
+                        self.update_node_to_uncordon_or_done_state(node_state)
+                        continue
+                    self.provider.change_node_upgrade_state(
+                        node, consts.UPGRADE_STATE_VALIDATION_REQUIRED
+                    )
+                elif self.is_driver_pod_failing(node_state.driver_pod):
+                    log_event(
+                        self.recorder,
+                        name_of(node),
+                        "Warning",
+                        util.get_event_reason(),
+                        "Driver pod is failing with repeated restarts",
+                    )
+                    self.provider.change_node_upgrade_state(
+                        node, consts.UPGRADE_STATE_FAILED
+                    )
         self.pod_manager.schedule_pods_restart(pods_to_restart)
 
     def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
@@ -435,19 +460,20 @@ class CommonUpgradeManager:
             if not self.is_driver_pod_in_sync(node_state):
                 continue
             node = node_state.node
-            annotations = (node.get("metadata") or {}).get("annotations") or {}
-            initial_key = util.get_upgrade_initial_state_annotation_key()
-            if initial_key in annotations:
-                self.provider.change_node_upgrade_state(
-                    node, consts.UPGRADE_STATE_DONE
-                )
-                self.provider.change_node_upgrade_annotation(
-                    node, initial_key, consts.NULL_STRING
-                )
-            else:
-                self.provider.change_node_upgrade_state(
-                    node, consts.UPGRADE_STATE_UNCORDON_REQUIRED
-                )
+            with self._node_span(node, consts.UPGRADE_STATE_FAILED):
+                annotations = (node.get("metadata") or {}).get("annotations") or {}
+                initial_key = util.get_upgrade_initial_state_annotation_key()
+                if initial_key in annotations:
+                    self.provider.change_node_upgrade_state(
+                        node, consts.UPGRADE_STATE_DONE
+                    )
+                    self.provider.change_node_upgrade_annotation(
+                        node, initial_key, consts.NULL_STRING
+                    )
+                else:
+                    self.provider.change_node_upgrade_state(
+                        node, consts.UPGRADE_STATE_UNCORDON_REQUIRED
+                    )
 
     def process_validation_required_nodes(self, state: ClusterUpgradeState) -> None:
         """Reference: ProcessValidationRequiredNodes (:573-604)."""
@@ -462,12 +488,13 @@ class CommonUpgradeManager:
             # the node is deliberately parked at the barrier.
             if self.held_at_slice_load_barrier(node_state, blocked_domains):
                 continue
-            # The driver may have restarted after entering validation; make
-            # sure it is not blocked on safe load (:576-583).
-            self.safe_driver_load_manager.unblock_loading(node)
-            if not self.validation_manager.validate(node):
-                continue
-            self.update_node_to_uncordon_or_done_state(node_state)
+            with self._node_span(node, consts.UPGRADE_STATE_VALIDATION_REQUIRED):
+                # The driver may have restarted after entering validation;
+                # make sure it is not blocked on safe load (:576-583).
+                self.safe_driver_load_manager.unblock_loading(node)
+                if not self.validation_manager.validate(node):
+                    continue
+                self.update_node_to_uncordon_or_done_state(node_state)
 
     def update_node_to_uncordon_or_done_state(
         self, node_state: NodeUpgradeState

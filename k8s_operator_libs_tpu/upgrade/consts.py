@@ -172,6 +172,15 @@ PRE_DRAIN_CHECKPOINT_ANNOTATION_KEY_FMT = DOMAIN + "/%s-pre-drain-checkpoint"
 PRE_DRAIN_CHECKPOINT_REQUESTED = "requested"
 PRE_DRAIN_CHECKPOINT_DONE = "done"
 
+#: Sibling annotation carrying the orchestrator's W3C ``traceparent``
+#: across the handshake, so the workload's checkpoint save appears as a
+#: child span of the drain that requested it (set/cleared together with
+#: the checkpoint annotation; a separate key keeps the request/ack
+#: token protocol untouched).
+PRE_DRAIN_TRACEPARENT_ANNOTATION_KEY_FMT = (
+    DOMAIN + "/%s-pre-drain-traceparent"
+)
+
 #: Node labels (checked in order) from which the slice identity is derived.
 #: Hosts sharing a value form one atomic unavailability domain.
 SLICE_ID_LABEL_KEYS = (

@@ -13,6 +13,7 @@ from typing import Optional
 from ..cluster.client import ClusterClient
 from ..cluster.inmem import JsonObj
 from ..cluster.objects import name_of, node_is_unschedulable
+from ..obs import tracing
 from . import util
 from .util import EventRecorder, log_event
 
@@ -36,7 +37,12 @@ class CordonManager:
         if node_is_unschedulable(node) == desired:
             return
         name = name_of(node)
-        self._cluster.patch("Node", name, {"spec": {"unschedulable": desired}})
+        with tracing.start_span(
+            "cordon" if desired else "uncordon", attributes={"node": name}
+        ):
+            self._cluster.patch(
+                "Node", name, {"spec": {"unschedulable": desired}}
+            )
         node.setdefault("spec", {})["unschedulable"] = desired
         log_event(
             self._recorder,

@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Protocol, Tuple
 
 from .. import metrics
+from ..obs import tracing
 from ..api.upgrade_spec import DrainSpec
 from ..cluster.errors import NotFoundError, TooManyRequestsError
 from ..cluster.client import ClusterClient
@@ -294,12 +295,16 @@ class DrainManager:
         """Reference: ScheduleNodesDrain (drain_manager.go:98-137)."""
         if not config.spec or not config.spec.enable:
             raise DrainError("drain spec must be enabled to schedule drains")
+        # The worker runs on a pool thread where the reconcile's context
+        # is invisible; the traceparent string is the explicit carrier
+        # that keeps its span inside the scheduling reconcile's trace.
+        traceparent = tracing.current_traceparent()
         for node in config.nodes:
             name = name_of(node)
             if not self._in_flight.add_if_absent(name):
                 logger.debug("drain already in flight for node %s", name)
                 continue
-            self._pool.submit(self._drain_one, node, config.spec)
+            self._pool.submit(self._drain_one, node, config.spec, traceparent)
 
     def wait_idle(self, timeout: float = 10.0) -> bool:
         """Test/simulation helper: wait until no drains are in flight."""
@@ -311,51 +316,66 @@ class DrainManager:
         return True
 
     # ------------------------------------------------------------- internals
-    def _drain_one(self, node: JsonObj, spec: DrainSpec) -> None:
+    def _drain_one(
+        self,
+        node: JsonObj,
+        spec: DrainSpec,
+        traceparent: Optional[str] = None,
+    ) -> None:
         name = name_of(node)
         started = time.monotonic()
-        try:
-            # Cordon first (kubectl drain always cordons).
-            self._cordon_manager.cordon(node)
-            if self._gate is not None:
-                self._gate.wait_for_checkpoint(node)
-            helper = DrainHelper(
-                self._cluster,
-                DrainHelperConfig(
-                    force=spec.force,
-                    delete_empty_dir=spec.delete_empty_dir,
-                    ignore_all_daemon_sets=True,
-                    grace_period_seconds=spec.grace_period_seconds,
-                    timeout_seconds=spec.timeout_second,
-                    pod_selector=spec.pod_selector,
-                    disable_eviction=spec.disable_eviction,
-                ),
+        with tracing.start_span(
+            "drain", attributes={"node": name}, traceparent=traceparent
+        ) as span:
+            try:
+                # Cordon first (kubectl drain always cordons).
+                self._cordon_manager.cordon(node)
+                if self._gate is not None:
+                    self._gate.wait_for_checkpoint(node)
+                helper = DrainHelper(
+                    self._cluster,
+                    DrainHelperConfig(
+                        force=spec.force,
+                        delete_empty_dir=spec.delete_empty_dir,
+                        ignore_all_daemon_sets=True,
+                        grace_period_seconds=spec.grace_period_seconds,
+                        timeout_seconds=spec.timeout_second,
+                        pod_selector=spec.pod_selector,
+                        disable_eviction=spec.disable_eviction,
+                    ),
+                )
+                pods, errors = helper.get_pods_for_deletion(name)
+                span.set_attribute("pods_evicted", len(pods))
+                if errors:
+                    raise DrainError("; ".join(errors))
+                helper.delete_or_evict_pods(pods)
+            except Exception as err:  # noqa: BLE001 — worker boundary
+                logger.error("drain failed for node %s: %s", name, err)
+                log_event(
+                    self._recorder,
+                    name,
+                    "Warning",
+                    util.get_event_reason(),
+                    f"Failed to drain node: {err}",
+                )
+                span.set_status("error", str(err))
+                metrics.record_drain(
+                    "failed", time.monotonic() - started,
+                    trace_id=span.trace_id,
+                )
+                self._finish(node, consts.UPGRADE_STATE_FAILED)
+                return
+            metrics.record_drain(
+                "ok", time.monotonic() - started, trace_id=span.trace_id
             )
-            pods, errors = helper.get_pods_for_deletion(name)
-            if errors:
-                raise DrainError("; ".join(errors))
-            helper.delete_or_evict_pods(pods)
-        except Exception as err:  # noqa: BLE001 — worker boundary
-            logger.error("drain failed for node %s: %s", name, err)
             log_event(
                 self._recorder,
                 name,
-                "Warning",
+                "Normal",
                 util.get_event_reason(),
-                f"Failed to drain node: {err}",
+                "Node drained successfully",
             )
-            metrics.record_drain("failed", time.monotonic() - started)
-            self._finish(node, consts.UPGRADE_STATE_FAILED)
-            return
-        metrics.record_drain("ok", time.monotonic() - started)
-        log_event(
-            self._recorder,
-            name,
-            "Normal",
-            util.get_event_reason(),
-            "Node drained successfully",
-        )
-        self._finish(node, consts.UPGRADE_STATE_POD_RESTART_REQUIRED)
+            self._finish(node, consts.UPGRADE_STATE_POD_RESTART_REQUIRED)
 
     def _finish(self, node: JsonObj, state: str) -> None:
         try:

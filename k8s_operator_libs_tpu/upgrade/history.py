@@ -143,6 +143,14 @@ def node_event_history(
             component=source_component,
         )
     out = list(seen.values())
+    if node is not None and not out:
+        # Empty could mean "no events yet" OR "no such node" — different
+        # answers (a typo'd --node must not read as a clean history).
+        # Disambiguate against the Node object itself when the source can
+        # serve one; NotFoundError propagates to the caller.
+        getter = getattr(cluster, "get", None)
+        if callable(getter):
+            getter("Node", node)
     # ISO-8601 UTC strings order lexicographically; ties break on node
     out.sort(key=lambda e: (e.last_timestamp, e.node, e.reason))
     return out

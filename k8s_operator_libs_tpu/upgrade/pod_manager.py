@@ -36,6 +36,7 @@ from ..cluster.objects import (
     namespace_of,
     pod_phase,
 )
+from ..obs import tracing
 from . import consts, util
 from .drain_manager import DrainHelper, DrainHelperConfig
 from .node_upgrade_state_provider import NodeUpgradeStateProvider
@@ -203,16 +204,34 @@ class PodManager:
             raise PodManagerError(
                 "pod_deletion_filter is required to schedule pod eviction"
             )
+        # Carried explicitly: the worker thread cannot see the scheduling
+        # reconcile's span context (same pattern as DrainManager).
+        traceparent = tracing.current_traceparent()
         for node in config.nodes:
             name = name_of(node)
             if not self._nodes_in_progress.add_if_absent(name):
                 logger.debug("pods already being deleted on node %s", name)
                 continue
             self._pool.submit(
-                self._evict_one, node, config.deletion_spec, config.drain_enabled
+                self._evict_one, node, config.deletion_spec,
+                config.drain_enabled, traceparent,
             )
 
     def _evict_one(
+        self,
+        node: JsonObj,
+        spec: PodDeletionSpec,
+        drain_enabled: bool,
+        traceparent: Optional[str] = None,
+    ) -> None:
+        with tracing.start_span(
+            "pod-eviction",
+            attributes={"node": name_of(node)},
+            traceparent=traceparent,
+        ):
+            self._evict_one_traced(node, spec, drain_enabled)
+
+    def _evict_one_traced(
         self, node: JsonObj, spec: PodDeletionSpec, drain_enabled: bool
     ) -> None:
         name = name_of(node)
@@ -299,20 +318,27 @@ class PodManager:
         """Delete driver pods so their DaemonSet recreates them at the new
         revision (reference: SchedulePodsRestart, pod_manager.go:233-251 —
         synchronous; an individual failure aborts with an error)."""
-        for pod in pods:
-            try:
-                self._cluster.delete("Pod", name_of(pod), namespace_of(pod))
-            except NotFoundError:
-                pass
-            except Exception as err:  # noqa: BLE001
-                log_event(
-                    self._recorder,
-                    name_of(pod),
-                    "Warning",
-                    util.get_event_reason(),
-                    f"Failed to restart driver pod {err}",
-                )
-                raise
+        if not pods:
+            return
+        with tracing.start_span(
+            "pod-restart", attributes={"pods": len(pods)}
+        ):
+            for pod in pods:
+                try:
+                    self._cluster.delete(
+                        "Pod", name_of(pod), namespace_of(pod)
+                    )
+                except NotFoundError:
+                    pass
+                except Exception as err:  # noqa: BLE001
+                    log_event(
+                        self._recorder,
+                        name_of(pod),
+                        "Warning",
+                        util.get_event_reason(),
+                        f"Failed to restart driver pod {err}",
+                    )
+                    raise
 
     # -------------------------------------------------------- completion wait
     def is_pod_running_or_pending(self, pod: JsonObj) -> bool:

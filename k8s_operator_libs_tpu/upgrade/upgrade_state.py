@@ -27,6 +27,7 @@ from contextlib import nullcontext
 from typing import Dict, List, Optional, Tuple
 
 from .. import metrics
+from ..obs import tracing
 from ..api.upgrade_spec import UpgradePolicySpec
 from ..cluster.cache import InformerCache
 from ..cluster.errors import NotFoundError
@@ -271,12 +272,18 @@ class ClusterUpgradeStateManager:
     ) -> ClusterUpgradeState:
         """Snapshot construction (reference: BuildState, :99-164)."""
         started = time.monotonic()
-        try:
-            return self._build_state(namespace, driver_labels)
-        finally:
-            # finally: failed snapshots are exactly the slow outliers the
-            # latency histogram exists to surface
-            metrics.observe_reconcile("build", time.monotonic() - started)
+        with tracing.start_span(
+            "BuildState", attributes={"namespace": namespace}
+        ) as span:
+            try:
+                return self._build_state(namespace, driver_labels)
+            finally:
+                # finally: failed snapshots are exactly the slow outliers
+                # the latency histogram exists to surface
+                metrics.observe_reconcile(
+                    "build", time.monotonic() - started,
+                    trace_id=span.trace_id,
+                )
 
     def _build_state(
         self, namespace: str, driver_labels: Dict[str, str]
@@ -421,12 +428,19 @@ class ClusterUpgradeStateManager:
                     "never granted maintenance"
                 )
         started = time.monotonic()
-        try:
-            self._apply_state(common, state, policy)
-        finally:
-            # finally: an aborted reconcile (e.g. cache-sync timeout) is
-            # the latency outlier the histogram must not silently drop
-            metrics.observe_reconcile("apply", time.monotonic() - started)
+        with tracing.start_span(
+            "ApplyState",
+            attributes={"nodes": sum(len(v) for v in state.node_states.values())},
+        ) as span:
+            try:
+                self._apply_state(common, state, policy)
+            finally:
+                # finally: an aborted reconcile (e.g. cache-sync timeout) is
+                # the latency outlier the histogram must not silently drop
+                metrics.observe_reconcile(
+                    "apply", time.monotonic() - started,
+                    trace_id=span.trace_id,
+                )
 
     def _restore_policy_defaults(self) -> None:
         """Undo every policy-pushed override (topology keys, cache-sync

@@ -176,6 +176,11 @@ def get_pre_drain_checkpoint_annotation_key() -> str:
     return consts.PRE_DRAIN_CHECKPOINT_ANNOTATION_KEY_FMT % get_component_name()
 
 
+def get_pre_drain_traceparent_annotation_key() -> str:
+    """TPU-native: trace-context carrier for the checkpoint handshake."""
+    return consts.PRE_DRAIN_TRACEPARENT_ANNOTATION_KEY_FMT % get_component_name()
+
+
 def get_quarantine_annotation_key() -> str:
     """TPU-native: degraded-domain quarantine annotation key."""
     return consts.UPGRADE_QUARANTINE_ANNOTATION_KEY_FMT % get_component_name()

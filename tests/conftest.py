@@ -51,6 +51,19 @@ def component_name():
 
 
 @pytest.fixture(autouse=True)
+def fresh_tracer():
+    """Per-test trace isolation: the default tracer is process-global
+    (like the metrics registry); a fresh one per test keeps span trees
+    from leaking across tests while still exercising the always-on
+    instrumentation everywhere."""
+    from k8s_operator_libs_tpu.obs import tracing
+
+    previous = tracing.set_default_tracer(tracing.Tracer())
+    yield
+    tracing.set_default_tracer(previous)
+
+
+@pytest.fixture(autouse=True)
 def reset_topology_label_keys():
     """Per-policy topology key overrides are process-global (like the
     component name); restore defaults between tests."""

@@ -733,6 +733,89 @@ class TestOpsServer:
         finally:
             srv.stop()
 
+    def _head(self, url):
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(url, method="HEAD")
+        try:
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as err:
+            return err.code, err.read(), dict(err.headers)
+
+    def test_head_answers_without_body(self):
+        """Probe fleets that HEAD before GET must see the real status and
+        headers with an empty body — not http.server's default 501."""
+        from k8s_operator_libs_tpu import metrics
+        from k8s_operator_libs_tpu.controller import OpsServer
+
+        registry = metrics.MetricsRegistry()
+        registry.counter("head_probe_total", "c").inc()
+        srv = OpsServer(port=0, registry=registry).start()
+        try:
+            status, body, headers = self._head(srv.url + "/metrics")
+            assert status == 200
+            assert body == b""
+            # Content-Length still advertises the (non-empty) GET body size
+            assert int(headers["Content-Length"]) > 0
+            status, body, _ = self._head(srv.url + "/healthz")
+            assert status == 200 and body == b""
+            # regression: unknown paths answer 404 for HEAD too — no
+            # 500, no hang
+            status, body, _ = self._head(srv.url + "/nope")
+            assert status == 404 and body == b""
+        finally:
+            srv.stop()
+
+    def test_metrics_openmetrics_negotiation(self):
+        """Accept: application/openmetrics-text switches to the
+        OpenMetrics rendering (exemplar-capable, # EOF terminated);
+        plain scrapes keep the 0.0.4 exposition."""
+        import urllib.request
+
+        from k8s_operator_libs_tpu import metrics
+        from k8s_operator_libs_tpu.controller import OpsServer
+
+        registry = metrics.MetricsRegistry()
+        registry.histogram("om_seconds", "h").observe(
+            0.2, exemplar={"trace_id": "abc123"}
+        )
+        srv = OpsServer(port=0, registry=registry).start()
+        try:
+            status, body, headers = self._get(srv.url + "/metrics")
+            assert status == 200
+            assert "0.0.4" in headers.get("Content-Type", "")
+            assert "# EOF" not in body and "trace_id" not in body
+            req = urllib.request.Request(
+                srv.url + "/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            )
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                om_type = resp.headers.get("Content-Type", "")
+                om_body = resp.read().decode()
+            assert "openmetrics-text" in om_type
+            assert om_body.rstrip().endswith("# EOF")
+            assert '# {trace_id="abc123"} 0.2' in om_body
+        finally:
+            srv.stop()
+
+    def test_debug_traces_bad_fmt_400(self):
+        from k8s_operator_libs_tpu.controller import OpsServer
+        from k8s_operator_libs_tpu.obs import tracing
+
+        srv = OpsServer(port=0, tracer=tracing.Tracer()).start()
+        try:
+            status, body, _ = self._get(srv.url + "/debug/traces?fmt=wat")
+            assert status == 400 and "unknown fmt" in body
+            status, body, _ = self._get(srv.url + "/debug/traces")
+            assert status == 200
+            import json as _json
+
+            assert _json.loads(body)["resourceSpans"]
+        finally:
+            srv.stop()
+
     def test_stop_is_idempotent_and_restart_refused(self):
         from k8s_operator_libs_tpu.controller import OpsServer
 

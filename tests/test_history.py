@@ -7,6 +7,7 @@ import json
 
 from k8s_operator_libs_tpu.api import DrainSpec, IntOrString, UpgradePolicySpec
 from k8s_operator_libs_tpu.cluster import InMemoryCluster
+from k8s_operator_libs_tpu.cluster.objects import make_node
 from k8s_operator_libs_tpu.upgrade import (
     ClusterUpgradeStateManager,
     consts,
@@ -244,6 +245,69 @@ class TestHistoryReviewRegressions:
         filtered = node_event_history(cluster, component=get_event_reason())
         assert filtered
         assert all(e.component == get_event_reason() for e in filtered)
+
+    def test_offline_dump_with_no_events_renders_empty(self, tmp_path, capsys):
+        """A dump captured before any rollout has zero Events: the CLI
+        must print the empty-table sentinel (and [] with --json), rc 0."""
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+
+        cluster = InMemoryCluster()
+        path = tmp_path / "cluster.json"
+        path.write_text(json.dumps(cluster.to_dict()))
+        rc = cli_main(["history", "--state-file", str(path)])
+        assert rc == 0
+        assert "No node upgrade events found." in capsys.readouterr().out
+        rc = cli_main(["history", "--state-file", str(path), "--json"])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out) == []
+
+    def test_offline_dump_missing_count_and_timestamps(self):
+        """Hand-pruned dumps (or events.k8s.io writers) may omit count
+        and every timestamp; entries default (count=1, empty stamps sort
+        first) instead of tracebacking."""
+        cluster = InMemoryCluster()
+        cluster.create(make_node("n9"))
+        cluster.create(
+            {
+                "kind": "Event",
+                "metadata": {"name": "n9.bare", "namespace": "default"},
+                "involvedObject": {"kind": "Node", "name": "n9"},
+                "reason": "Sparse",
+                "message": "no count, no timestamps",
+                "type": "Normal",
+            }
+        )
+        entries = node_event_history(cluster, node="n9")
+        assert len(entries) == 1
+        entry = entries[0]
+        assert entry.count == 1
+        assert entry.first_timestamp == "" and entry.last_timestamp == ""
+        text = render_history(entries)
+        assert "Sparse" in text and "n9" in text
+
+    def test_unknown_node_filter_raises_not_found(self, tmp_path, capsys):
+        """--node naming a node the dump has never heard of must be a
+        NotFoundError (CLI exit 3), never a clean empty timeline — a
+        typo'd node name reading as 'all done' is how stuck rollouts
+        hide."""
+        import pytest as _pytest
+
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+        from k8s_operator_libs_tpu.cluster.errors import NotFoundError
+
+        cluster = _rolled_cluster()
+        with _pytest.raises(NotFoundError):
+            node_event_history(cluster, node="no-such-node")
+        # a node that EXISTS but has no events is a real empty answer
+        cluster.create(make_node("quiet-node"))
+        assert node_event_history(cluster, node="quiet-node") == []
+        path = tmp_path / "cluster.json"
+        path.write_text(json.dumps(cluster.to_dict()))
+        rc = cli_main(
+            ["history", "--state-file", str(path), "--node", "no-such-node"]
+        )
+        assert rc == 3
+        assert "not found" in capsys.readouterr().err
 
     def test_event_time_fallback_for_new_style_events(self):
         """events.k8s.io writers fill eventTime and leave the legacy

@@ -1,0 +1,483 @@
+"""End-to-end reconcile tracing (obs/tracing.py): span trees from
+BuildState through the TPU drain handshake, exporters, log injection,
+and the metrics-exemplar correlation hook."""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from k8s_operator_libs_tpu import metrics
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    PreDrainCheckpointSpec,
+    UpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.cluster import InMemoryCluster
+from k8s_operator_libs_tpu.obs import tracing
+from k8s_operator_libs_tpu.tpu.drain_handshake import (
+    CheckpointDrainGate,
+    DrainSignalWatcher,
+)
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    consts,
+)
+
+from harness import DRIVER_LABELS, NAMESPACE, Fleet
+
+
+class TestSpanBasics:
+    def test_nesting_and_context_restore(self):
+        tracer = tracing.Tracer()
+        with tracer.start_span("root") as root:
+            assert tracer.current_span() is root
+            with tracer.start_span("child") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+            assert tracer.current_span() is root
+        assert tracer.current_span() is None
+        (trace,) = tracer.traces()
+        assert trace["complete"] and trace["name"] == "root"
+        assert {s["name"] for s in trace["spans"]} == {"root", "child"}
+
+    def test_exception_marks_error_status(self):
+        tracer = tracing.Tracer()
+        with pytest.raises(RuntimeError):
+            with tracer.start_span("boom"):
+                raise RuntimeError("drain wedged")
+        (trace,) = tracer.traces()
+        (span,) = trace["spans"]
+        assert span["status"] == "error"
+        assert "drain wedged" in span["status_message"]
+
+    def test_traceparent_round_trip_and_rejects_garbage(self):
+        with tracing.start_span("root") as root:
+            carrier = tracing.current_traceparent()
+        assert tracing.parse_traceparent(carrier) == (
+            root.trace_id,
+            root.span_id,
+        )
+        for bad in (None, "", "junk", "00-zz-yy-01", "00-" + "0" * 32 + "-" + "1" * 16 + "-01"):
+            assert tracing.parse_traceparent(bad) is None
+
+    def test_cross_thread_handoff_joins_the_trace(self):
+        tracer = tracing.Tracer()
+        seen = {}
+
+        def worker(carrier):
+            with tracer.start_span("async-work", traceparent=carrier) as span:
+                seen["trace_id"] = span.trace_id
+
+        with tracer.start_span("root") as root:
+            t = threading.Thread(target=worker, args=(root.traceparent,))
+            t.start()
+            t.join(2.0)
+        assert seen["trace_id"] == root.trace_id
+        (trace,) = tracer.traces()
+        assert {s["name"] for s in trace["spans"]} == {"root", "async-work"}
+
+    def test_late_async_span_lands_in_completed_trace(self):
+        """A drain worker ending after the reconcile root closed must
+        still append to the (already completed) trace — the async-result
+        pattern the whole state machine is built on."""
+        tracer = tracing.Tracer()
+        with tracer.start_span("root") as root:
+            carrier = root.traceparent
+        assert tracer.traces()[0]["complete"]
+        with tracer.start_span("late-drain", traceparent=carrier):
+            pass
+        (trace,) = tracer.traces()
+        assert "late-drain" in {s["name"] for s in trace["spans"]}
+
+    def test_orphan_child_of_evicted_trace_dropped_not_resurrected(self):
+        """A child span whose trace a FULL buffer already evicted must
+        not create a ghost (never-complete) entry that evicts a real
+        completed trace — it is counted and dropped."""
+        tracer = tracing.Tracer(capacity=2)
+        with tracer.start_span("old") as old:
+            carrier = old.traceparent
+        for i in range(2):  # evicts "old"
+            with tracer.start_span(f"new{i}"):
+                pass
+        survivors = {t["name"] for t in tracer.traces()}
+        with tracer.start_span("late-child", traceparent=carrier):
+            pass
+        assert tracer.orphan_spans == 1
+        assert {t["name"] for t in tracer.traces()} == survivors
+
+    def test_full_buffer_keeps_interiors_of_new_traces(self):
+        """Steady state (buffer at capacity for the rest of the process
+        lifetime): new reconcile trees must keep their INTERIOR spans —
+        children record before their root, and a naive orphan guard
+        would drop them all once eviction holds the buffer at
+        capacity."""
+        tracer = tracing.Tracer(capacity=2)
+        for i in range(5):  # well past capacity
+            with tracer.start_span(f"root{i}"):
+                with tracer.start_span("child"):
+                    pass
+        traces = tracer.traces()
+        assert len(traces) == 2
+        for trace in traces:
+            assert {s["name"] for s in trace["spans"]} >= {"child"}
+        assert tracer.orphan_spans == 0
+
+    def test_capacity_evicts_oldest(self):
+        tracer = tracing.Tracer(capacity=2)
+        ids = []
+        for i in range(3):
+            with tracer.start_span(f"r{i}") as span:
+                ids.append(span.trace_id)
+        kept = {t["trace_id"] for t in tracer.traces()}
+        assert kept == set(ids[1:])
+
+    def test_span_cap_counts_drops(self):
+        tracer = tracing.Tracer(max_spans_per_trace=2)
+        with tracer.start_span("root"):
+            for _ in range(3):
+                with tracer.start_span("child"):
+                    pass
+        (trace,) = tracer.traces()
+        assert len(trace["spans"]) == 2
+        assert trace["dropped_spans"] == 2  # 2 extra children + root
+
+    def test_record_span_backdates(self):
+        tracer = tracing.Tracer()
+        with tracer.start_span("root") as root:
+            queued = tracer.record_span("queue-wait", 1.5, parent=root)
+        assert queued.duration == pytest.approx(1.5, abs=0.05)
+        assert queued.parent_id == root.span_id
+        assert tracer.current_span() is None
+
+    def test_default_tracer_swap(self):
+        mine = tracing.Tracer()
+        prev = tracing.set_default_tracer(mine)
+        try:
+            with tracing.start_span("via-module"):
+                assert tracing.current_trace_id() is not None
+            assert mine.traces()
+        finally:
+            tracing.set_default_tracer(prev)
+
+
+class TestExportersAndCli:
+    def _one_trace(self):
+        tracer = tracing.Tracer()
+        with tracer.start_span("Reconcile") as root:
+            with tracer.start_span("BuildState"):
+                time.sleep(0.001)
+        return tracer.traces(), root
+
+    def test_chrome_export_and_reimport(self):
+        traces, root = self._one_trace()
+        chrome = json.loads(json.dumps(tracing.to_chrome(traces)))
+        assert all(e["ph"] == "X" for e in chrome["traceEvents"])
+        assert {e["name"] for e in chrome["traceEvents"]} == {
+            "Reconcile", "BuildState",
+        }
+        back = tracing.traces_from_payload(chrome)
+        assert back[0]["trace_id"] == root.trace_id
+
+    def test_otlp_export_and_reimport(self):
+        traces, root = self._one_trace()
+        otlp = json.loads(json.dumps(tracing.to_otlp(traces)))
+        spans = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert all(
+            int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+            for s in spans
+        )
+        back = tracing.traces_from_payload(otlp)
+        assert {s["name"] for s in back[0]["spans"]} == {
+            "Reconcile", "BuildState",
+        }
+
+    def test_render_tree_orders_and_indents(self):
+        traces, _ = self._one_trace()
+        text = tracing.render_trace_tree(traces[0])
+        lines = text.splitlines()
+        assert "Reconcile" in lines[1]
+        assert "BuildState" in lines[2]
+        assert lines[2].index("BuildState") > lines[1].index("Reconcile")
+
+    def test_selftest_passes(self):
+        assert "ok" in tracing.selftest()
+
+    def test_cli_traces_file_and_selftest(self, tmp_path, capsys):
+        from k8s_operator_libs_tpu.__main__ import main as cli_main
+
+        traces, root = self._one_trace()
+        path = tmp_path / "traces.json"
+        path.write_text(json.dumps(tracing.to_otlp(traces)))
+        assert cli_main(["traces", "--file", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "Reconcile" in out and root.trace_id in out
+
+        assert cli_main(["traces", "--file", str(path), "--fmt", "chrome"]) == 0
+        chrome = json.loads(capsys.readouterr().out)
+        assert chrome["traceEvents"]
+
+        assert cli_main(["traces", "--selftest"]) == 0
+        assert "selftest ok" in capsys.readouterr().out
+
+        assert cli_main(["traces", "--file", str(tmp_path / "absent.json")]) == 2
+        capsys.readouterr()
+        (tmp_path / "junk.json").write_text("{\"nope\": 1}")
+        assert cli_main(["traces", "--file", str(tmp_path / "junk.json")]) == 2
+        capsys.readouterr()
+        assert (
+            cli_main(
+                ["traces", "--file", str(path), "--trace-id", "f" * 32]
+            )
+            == 3
+        )
+
+
+class TestLogInjection:
+    def test_filter_stamps_trace_and_span_ids(self):
+        tracer = tracing.Tracer()
+        filt = tracing.TraceContextFilter(tracer)
+        record = logging.LogRecord("x", logging.INFO, __file__, 1, "m", (), None)
+        filt.filter(record)
+        assert record.trace_id == "-" and record.span_id == "-"
+        with tracer.start_span("spanful") as span:
+            record2 = logging.LogRecord(
+                "x", logging.INFO, __file__, 1, "m", (), None
+            )
+            filt.filter(record2)
+            assert record2.trace_id == span.trace_id
+            assert record2.span_id == span.span_id
+
+    def test_install_on_logger_formats_trace_id(self):
+        tracer = tracing.Tracer()
+        prev = tracing.set_default_tracer(tracer)
+        logger = logging.getLogger("test.trace.inject")
+        import io
+
+        stream = io.StringIO()
+        handler = logging.StreamHandler(stream)
+        handler.setFormatter(logging.Formatter("%(trace_id)s %(message)s"))
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        filt = tracing.install_trace_logging(logger)
+        try:
+            with tracing.start_span("logged") as span:
+                logger.info("hello")
+            assert stream.getvalue().startswith(span.trace_id)
+        finally:
+            logger.removeFilter(filt)
+            logger.removeHandler(handler)
+            tracing.set_default_tracer(prev)
+
+
+def _run_traced_rollout(nodes: int = 3):
+    """A full stub-cluster upgrade under ONE root span, with the
+    checkpoint-drain handshake answered by a workload-side thread.
+    Returns (tracer, registry, root_span)."""
+    tracer = tracing.Tracer()
+    prev_tracer = tracing.set_default_tracer(tracer)
+    registry = metrics.MetricsRegistry()
+    prev_registry = metrics.set_default_registry(registry)
+    cluster = InMemoryCluster()
+    fleet = Fleet(cluster, revision_hash="v1")
+    for i in range(nodes):
+        fleet.add_node(f"n{i}")
+    fleet.publish_new_revision("v2")
+    gate = CheckpointDrainGate(
+        cluster, PreDrainCheckpointSpec(enable=True, timeout_second=5)
+    )
+    manager = ClusterUpgradeStateManager(
+        cluster,
+        pre_drain_gate=gate,
+        cache_sync_timeout_seconds=2.0,
+        cache_sync_poll_seconds=0.01,
+    )
+    stop = threading.Event()
+
+    def responder():
+        watchers = [
+            DrainSignalWatcher(cluster, f"n{i}") for i in range(nodes)
+        ]
+        while not stop.is_set():
+            for watcher in watchers:
+                watcher.check_and_acknowledge(lambda: None)
+            time.sleep(0.005)
+
+    thread = threading.Thread(target=responder, daemon=True)
+    thread.start()
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("100%"),
+        drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+    )
+    try:
+        with tracing.start_span("Upgrade", attributes={"nodes": nodes}) as root:
+            for _ in range(40):
+                state = manager.build_state(NAMESPACE, dict(DRIVER_LABELS))
+                manager.apply_state(state, policy)
+                manager.drain_manager.wait_idle(10.0)
+                manager.pod_manager.wait_idle(10.0)
+                fleet.reconcile_daemonset()
+                if set(fleet.states().values()) == {
+                    consts.UPGRADE_STATE_DONE
+                }:
+                    break
+            else:
+                raise AssertionError(f"no convergence: {fleet.states()}")
+    finally:
+        stop.set()
+        thread.join(2.0)
+        manager.shutdown()
+        tracing.set_default_tracer(prev_tracer)
+        metrics.set_default_registry(prev_registry)
+    return tracer, registry, root
+
+
+class TestEndToEndUpgradeTrace:
+    """The ISSUE acceptance: a ≥3-node stub-cluster upgrade produces ONE
+    trace spanning BuildState → per-node processing → drain → handshake →
+    pod restart, exportable as Chrome JSON, with the trace ID surfaced
+    as a drain_seconds exemplar."""
+
+    @pytest.fixture(scope="class")
+    def rollout(self):
+        return _run_traced_rollout(nodes=3)
+
+    def test_one_trace_spans_the_whole_pipeline(self, rollout):
+        tracer, _, root = rollout
+        trace = tracer.get_trace(root.trace_id)
+        assert trace is not None and trace["complete"]
+        names = {s["name"] for s in trace["spans"]}
+        assert {
+            "Upgrade",
+            "BuildState",
+            "ApplyState",
+            "ProcessNodeState",
+            "cordon",
+            "drain",
+            "drain-handshake",
+            "checkpoint-drain",
+            "pod-restart",
+        } <= names, f"missing spans: {names}"
+        # per-node coverage: every node got ProcessNodeState and drain spans
+        for name in ("ProcessNodeState", "drain"):
+            nodes_seen = {
+                s["attributes"].get("node")
+                for s in trace["spans"]
+                if s["name"] == name
+            }
+            assert {"n0", "n1", "n2"} <= nodes_seen
+
+    def test_handshake_child_carries_parent_trace_id(self, rollout):
+        tracer, _, root = rollout
+        trace = tracer.get_trace(root.trace_id)
+        spans = {s["span_id"]: s for s in trace["spans"]}
+        handshakes = [
+            s for s in trace["spans"] if s["name"] == "checkpoint-drain"
+        ]
+        assert handshakes
+        for span in handshakes:
+            # crossed the annotation boundary, still the same trace…
+            assert span["trace_id"] == root.trace_id
+            # …and parented under the gate's wait span inside the drain
+            parent = spans[span["parent_id"]]
+            assert parent["name"] == "drain-handshake"
+            grandparent = spans[parent["parent_id"]]
+            assert grandparent["name"] == "drain"
+
+    def test_drain_seconds_exemplar_carries_trace_id(self, rollout):
+        _, registry, root = rollout
+        exemplar = registry.histogram("drain_seconds", "x").exemplar()
+        assert exemplar is not None
+        labels, value, ts = exemplar
+        assert labels == {"trace_id": root.trace_id}
+        assert value >= 0 and ts > 0
+        # the OpenMetrics rendering exposes it; the 0.0.4 one must not
+        assert "# {trace_id=" in registry.render(openmetrics=True)
+        assert "# {trace_id=" not in registry.render()
+        reconcile_ex = registry.histogram(
+            "reconcile_seconds", "x", ("phase",)
+        ).exemplar("build")
+        assert reconcile_ex is not None
+        assert reconcile_ex[0]["trace_id"] == root.trace_id
+
+    def test_debug_traces_endpoint_serves_chrome_json(self, rollout):
+        from k8s_operator_libs_tpu.controller import OpsServer
+
+        tracer, registry, root = rollout
+        srv = OpsServer(port=0, registry=registry, tracer=tracer).start()
+        try:
+            with urllib.request.urlopen(
+                srv.url + "/debug/traces?fmt=chrome", timeout=5.0
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"] == "application/json"
+                chrome = json.loads(resp.read().decode())
+            events = chrome["traceEvents"]
+            assert events and all(
+                e["ph"] == "X" and isinstance(e["ts"], (int, float))
+                for e in events
+            )
+            assert {"BuildState", "drain", "checkpoint-drain"} <= {
+                e["name"] for e in events
+            }
+            # default (OTLP-flavoured) + trace_id filter round trips
+            with urllib.request.urlopen(
+                srv.url + f"/debug/traces?trace_id={root.trace_id}",
+                timeout=5.0,
+            ) as resp:
+                otlp = json.loads(resp.read().decode())
+            back = tracing.traces_from_payload(otlp)
+            assert len(back) == 1 and back[0]["trace_id"] == root.trace_id
+        finally:
+            srv.stop()
+
+
+class TestQueueWaitSpans:
+    def test_controller_reconcile_trace_includes_queue_wait(self, cluster):
+        from k8s_operator_libs_tpu.cluster.objects import make_node
+        from k8s_operator_libs_tpu.controller import Controller, Result
+
+        tracer = tracing.Tracer()
+        prev = tracing.set_default_tracer(tracer)
+
+        class Noop:
+            def reconcile(self, request):
+                return None
+
+        cluster.create(make_node("n1"))
+        ctrl = Controller(cluster, Noop(), name="traced").watches("Node")
+        try:
+            ctrl.start()
+            assert ctrl.wait_quiet(5.0)
+        finally:
+            ctrl.stop()
+            tracing.set_default_tracer(prev)
+        reconciles = [t for t in tracer.traces() if t["name"] == "Reconcile"]
+        assert reconciles
+        names = {s["name"] for s in reconciles[0]["spans"]}
+        assert "queue-wait" in names
+        root = next(
+            s for s in reconciles[0]["spans"] if s["name"] == "Reconcile"
+        )
+        assert root["attributes"]["controller"] == "traced"
+        assert root["attributes"]["queue_wait_s"] >= 0
+
+    def test_workqueue_reports_wait(self):
+        from k8s_operator_libs_tpu.controller import WorkQueue
+
+        q = WorkQueue()
+        q.add("item")
+        time.sleep(0.02)
+        assert q.get(timeout=1.0) == "item"
+        wait = q.queue_wait("item")
+        assert wait is not None and wait >= 0.02
+        q.done("item")
+        assert q.queue_wait("item") is None
