@@ -77,9 +77,6 @@ def run_real(args) -> int:
         kinds=("Node", "Pod", "DaemonSet", "ControllerRevision"),
         externally_fed=True,
     )
-    manager = ClusterUpgradeStateManager(
-        client, cache=cache, recorder=recorder, reads_from_cache=True
-    )
     labels = {}
     for pair in args.selector.split(","):
         if not pair:
@@ -92,6 +89,23 @@ def run_real(args) -> int:
             return 2
         key, value = pair.split("=", 1)
         labels[key] = value
+    # Incremental BuildState: the index rides the same watch tee as the
+    # cache (feed_index below), so every reconcile's snapshot assembles
+    # O(changed) from resident state instead of relisting the fleet —
+    # see docs/performance.md.  externally_fed: the single held stream
+    # is pop-once; the controller drains it for everyone.
+    from k8s_operator_libs_tpu.upgrade import ClusterStateIndex
+
+    state_index = ClusterStateIndex(
+        client, args.namespace, labels, externally_fed=True
+    )
+    manager = ClusterUpgradeStateManager(
+        client,
+        cache=cache,
+        recorder=recorder,
+        reads_from_cache=True,
+        state_index=state_index,
+    )
 
     def make_controller():
         # Held watch streams start/stop WITH the controller: a hot
@@ -105,9 +119,19 @@ def run_real(args) -> int:
             policy_source=CrPolicySource(client, args.policy, args.namespace),
             resync_seconds=args.resync_seconds,
             feed_cache=cache,
+            feed_index=state_index,
         )
+        # ControllerRevision/NodeMaintenance ride the held set too: the
+        # index watches them, and the controller only uses held streams
+        # when EVERY watched kind is held (a partial set degrades all
+        # kinds to bounded polling).
         return _HeldWatchRunnable(
-            client, ("Node", "Pod", "DaemonSet", "TpuUpgradePolicy"), controller
+            client,
+            (
+                "Node", "Pod", "DaemonSet", "TpuUpgradePolicy",
+                "ControllerRevision", "NodeMaintenance",
+            ),
+            controller,
         )
 
     if args.ha:
@@ -295,6 +319,9 @@ def run_demo() -> int:
         recorder=recorder,
         cache_sync_timeout_seconds=2.0,
         cache_sync_poll_seconds=0.01,
+        # self-driven incremental BuildState: the in-mem journal is
+        # multi-consumer, so the index advances itself at each build
+        use_state_index=True,
     )
     # The full CR-driven story: install the policy CRD (crdutil, the Helm
     # pre-install hook pattern), create a TpuUpgradePolicy CR, and run the

@@ -99,6 +99,15 @@ class InformerCache:
         """The cached kind set (None = every registered kind)."""
         return self._kinds
 
+    @property
+    def always_fresh(self) -> bool:
+        """True when reads pass straight through to the backend
+        (``lag_seconds <= 0``): a completed write is visible by
+        construction, so write-visibility waits are vacuous — the
+        provider skips its poll loop entirely (at fleet scale those
+        polls serialize on the store lock against the drain workers)."""
+        return self.lag_seconds <= 0
+
     # ------------------------------------------------------------ refresh
     def sync(self) -> None:
         """Force a FULL resync (the informer's initial list, and the 410

@@ -228,7 +228,11 @@ class InMemoryCluster:
         self._store: Dict[Key, JsonObj] = {}
         self._rv = 0
         self._journal: List[WatchEvent] = []
-        self._journal_cap = 10000
+        # Retention: floor entries, auto-scaled up with the store size
+        # (see _record).  Assigning _journal_cap pins retention exactly
+        # (tests force 410s with tiny windows) — see the property below.
+        self._journal_cap_floor = 10000
+        self._journal_autoscale = True
         self._journal_floor = 0  # highest seq evicted from the journal
         #: A real apiserver establishes CRDs asynchronously; 0 = synchronous.
         self.crd_establish_delay_seconds = crd_establish_delay_seconds
@@ -247,6 +251,11 @@ class InMemoryCluster:
         #: Bench A/B toggle: False forces every list into a full-store
         #: scan (the round-1 behavior) so the index win is measurable.
         self._use_indexes = use_indexes
+        #: Observable LIST-shaped operations served (list / list_page /
+        #: snapshot) — the cost the incremental BuildState exists to
+        #: avoid; the bench-scale guard test asserts the indexed path
+        #: issues strictly fewer of these than the full rebuild.
+        self.list_ops = 0
         # Chunked-LIST continue-token table: handle -> snapshot.  Tokens
         # expire (410 Gone) when the collection revision has advanced
         # past the journal retention window — the compaction analog —
@@ -261,6 +270,12 @@ class InMemoryCluster:
         # behavior, so plain unit tests that never apply CRDs are
         # untouched.
         self._crd_schemas: Dict[str, JsonObj] = {}
+        # uid generation: one random prefix per cluster + a counter.
+        # uuid4() costs ~17us of os.urandom PER CREATE — at fleet scale
+        # a single restart wave creates thousands of pods, and the store
+        # only needs uniqueness, not cryptographic randomness.
+        self._uid_prefix = uuid.uuid4().hex[:12]
+        self._uid_seq = 0
         # Copy-out accelerator: per-object marshal blob keyed by store
         # key, validated by the object's resourceVersion (every write
         # bumps rv through _next_rv, so a matching rv proves the blob is
@@ -275,6 +290,18 @@ class InMemoryCluster:
     def _next_rv(self) -> str:
         self._rv += 1
         return str(self._rv)
+
+    @property
+    def _journal_cap(self) -> int:
+        return self._journal_cap_floor
+
+    @_journal_cap.setter
+    def _journal_cap(self, value: int) -> None:
+        """Pin journal retention to exactly *value* entries.  Assigning
+        disables store-size auto-scaling — tests that shrink the window
+        to provoke 410 Gone need the cap to mean what they set."""
+        self._journal_cap_floor = value
+        self._journal_autoscale = False
 
     # ------------------------------------------------------------ index upkeep
     def _store_put(self, key: Key, obj: JsonObj) -> None:
@@ -324,8 +351,19 @@ class InMemoryCluster:
                 kind=kind, old_blob=old_blob, new_blob=new_blob,
             )
         )
-        if len(self._journal) > self._journal_cap:
-            evicted = len(self._journal) - self._journal_cap
+        # Retention scales with the store, floored at the cap — the
+        # watch-cache analog (a real apiserver sizes its cache with the
+        # resource count, and etcd's time-based compaction retains far
+        # more than 10k events on a busy fleet).  A FIXED cap made every
+        # fleet-scale reconcile wave (≥ cap writes per cycle at 8k+
+        # nodes) expire every journal consumer every cycle, degrading
+        # all incremental readers to per-cycle relists.  Assigning
+        # _journal_cap pins retention exactly (tests forcing 410s).
+        cap = self._journal_cap_floor
+        if self._journal_autoscale:
+            cap = max(cap, 2 * len(self._store))
+        if len(self._journal) > cap:
+            evicted = len(self._journal) - cap
             self._journal_floor = self._journal[evicted - 1].seq
             del self._journal[:evicted]
         self._journal_cond.notify_all()
@@ -434,7 +472,9 @@ class InMemoryCluster:
                 self._admit(stored)
             meta = stored.setdefault("metadata", {})
             meta["resourceVersion"] = self._next_rv()
-            meta.setdefault("uid", str(uuid.uuid4()))
+            if "uid" not in meta:
+                self._uid_seq += 1
+                meta["uid"] = f"{self._uid_prefix}-{self._uid_seq:08x}"
             meta.setdefault("creationTimestamp", time.time())
             self._store_put(key, stored)
             # One marshal.dumps serves the journal entry, this return
@@ -508,6 +548,7 @@ class InMemoryCluster:
         stored objects BEFORE copying (test/simulation convenience; a real
         client would filter after the fact)."""
         with self._lock:
+            self.list_ops += 1
             matches = self._scan(
                 kind, namespace, label_selector, field_filter, field_selector
             )
@@ -620,6 +661,7 @@ class InMemoryCluster:
             )
         request = (kind, namespace, label_selector, field_selector)
         with self._lock:
+            self.list_ops += 1
             if continue_token:
                 if resource_version:
                     raise BadRequestError(
@@ -1118,6 +1160,7 @@ class InMemoryCluster:
         """Deep-copied point-in-time view of the store (informer sync);
         *kinds* restricts the view (None = everything)."""
         with self._lock:
+            self.list_ops += 1
             if kinds is None:
                 return json_copy(self._store)
             wanted = set(kinds)

@@ -89,6 +89,18 @@ class _Watch:
     mapper: RequestMapper = field(default=_default_mapper)
 
 
+def _as_sinks(sink) -> tuple:
+    """Normalize a sink argument: None, one callable, or an iterable of
+    callables (the informer tee may feed several consumers — an
+    InformerCache AND a ClusterStateIndex — off the single watch
+    stream)."""
+    if sink is None:
+        return ()
+    if callable(sink):
+        return (sink,)
+    return tuple(sink)
+
+
 class Controller:
     """One reconciler + its watches + the queue + worker threads."""
 
@@ -117,10 +129,13 @@ class Controller:
         #: client must NOT consume it too.  *event_sink* receives every
         #: drained event batch BEFORE fan-out (reconciles woken by an
         #: event then read a cache that already reflects it) —
-        #: typically ``cache.ingest``; *relist_sink* runs on the 410
-        #: recovery path — typically ``cache.sync``.
-        self._event_sink = event_sink
-        self._relist_sink = relist_sink
+        #: typically ``cache.ingest``, and/or the incremental-BuildState
+        #: index's ``ingest`` (which feeds its dirty-node set);
+        #: *relist_sink* runs on the 410 recovery path — typically
+        #: ``cache.sync`` / ``index.rebuild``.  Both accept a single
+        #: callable or an iterable of callables.
+        self._event_sinks = _as_sinks(event_sink)
+        self._relist_sinks = _as_sinks(relist_sink)
         self._watches: List[_Watch] = []
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
@@ -150,14 +165,14 @@ class Controller:
         if not self._watches:
             raise RuntimeError("controller has no watches")
         self._started = True
-        if self._relist_sink is not None:
-            # an externally-fed cache may have missed frames while NO
-            # controller drained the stream (HA failover gap, restart):
-            # a full resync before the watch threads start closes it —
-            # frames queued meanwhile re-apply under the cache's
-            # monotonic guard
+        for sink in self._relist_sinks:
+            # an externally-fed cache/index may have missed frames while
+            # NO controller drained the stream (HA failover gap,
+            # restart): a full resync before the watch threads start
+            # closes it — frames queued meanwhile re-apply under the
+            # consumer's monotonic guard
             try:
-                self._relist_sink()
+                sink()
             except Exception as err:  # noqa: BLE001 — thread boundary
                 logger.error(
                     "%s: startup relist sink failed: %s", self.name, err
@@ -273,15 +288,16 @@ class Controller:
                 logger.error("%s: watch poll failed: %s", self.name, err)
                 self._stop.wait(self._poll)
                 continue
-            if self._event_sink is not None and events:
-                try:
-                    self._event_sink(events)
-                except Exception as err:  # noqa: BLE001 — thread boundary
-                    logger.error(
-                        "%s: event sink failed (cache may lag until "
-                        "resync): %s",
-                        self.name, err,
-                    )
+            if events:
+                for sink in self._event_sinks:
+                    try:
+                        sink(events)
+                    except Exception as err:  # noqa: BLE001 — thread boundary
+                        logger.error(
+                            "%s: event sink failed (cache may lag until "
+                            "resync): %s",
+                            self.name, err,
+                        )
             for event in events:
                 try:
                     self._fan_out(event)
@@ -308,9 +324,9 @@ class Controller:
                 self._queue.add(request)
 
     def _safe_relist(self) -> None:
-        if self._relist_sink is not None:
+        for sink in self._relist_sinks:
             try:
-                self._relist_sink()
+                sink()
             except Exception as err:  # noqa: BLE001 — thread boundary
                 logger.error("%s: relist sink failed: %s", self.name, err)
         try:

@@ -179,6 +179,7 @@ def new_upgrade_controller(
     gated_requeue_seconds: float = 5.0,
     watch_poll_seconds: float = 0.005,
     feed_cache=None,
+    feed_index=None,
 ) -> Controller:
     """Assemble the standard operator: watches on Nodes, driver Pods,
     DaemonSets (and NodeMaintenance when requestor mode needs it via
@@ -191,7 +192,16 @@ def new_upgrade_controller(
     *feed_cache*: an ``externally_fed`` :class:`~..cluster.InformerCache`
     to tee every drained watch event into (the single-reflector rule —
     one consumer feeds both cache and workqueue); its kinds are added to
-    the controller's watches so their frames flow."""
+    the controller's watches so their frames flow.
+
+    *feed_index*: a :class:`~..upgrade.ClusterStateIndex` to ride the
+    same tee — every drained event batch feeds its snapshot AND its
+    dirty-node set (so the next reconcile's BuildState is O(changed)),
+    and the 410 relist path triggers its full rebuild.  Its watch kinds
+    (ControllerRevision, NodeMaintenance, ...) are added with a
+    no-request mapper when not already watched.  Usually this is
+    ``manager.state_index`` from a manager built with
+    ``use_state_index=True``."""
     if (policy is None) == (policy_source is None):
         raise ValueError("pass exactly one of policy / policy_source")
     if policy_source is not None and not callable(
@@ -212,24 +222,36 @@ def new_upgrade_controller(
         failed_requeue_seconds=failed_requeue_seconds,
         gated_requeue_seconds=gated_requeue_seconds,
     )
+    event_sinks = []
+    relist_sinks = []
+    if feed_cache is not None:
+        event_sinks.append(feed_cache.ingest)
+        relist_sinks.append(feed_cache.sync)
+    if feed_index is not None:
+        event_sinks.append(feed_index.ingest)
+        relist_sinks.append(feed_index.rebuild)
     controller = Controller(
         cluster,
         reconciler,
         name="upgrade-controller",
         resync_seconds=resync_seconds,
         watch_poll_seconds=watch_poll_seconds,
-        event_sink=feed_cache.ingest if feed_cache is not None else None,
-        relist_sink=feed_cache.sync if feed_cache is not None else None,
+        event_sink=event_sinks or None,
+        relist_sink=relist_sinks or None,
     )
     kinds = ["Node", "Pod", "DaemonSet", *extra_kinds]
     if policy_source is not None:
         kinds.append(POLICY_KIND)
-    if feed_cache is not None:
-        # cache kinds must ride the SAME stream: watch them with a
-        # no-request mapper so their frames reach the sink
-        for kind in feed_cache.kinds or ():
-            if kind not in kinds:
-                controller.watches(kind, mapper=_null_mapper)
+    # tee'd consumers' kinds must ride the SAME stream: watch them with
+    # a no-request mapper so their frames reach the sinks (a kind both
+    # reconcile-mapped and sink-consumed is watched once — the sinks see
+    # every drained batch regardless of mapper)
+    sink_kinds = list((feed_cache.kinds or ()) if feed_cache else ())
+    if feed_index is not None:
+        sink_kinds.extend(feed_index.WATCH_KINDS)
+    null_mapped = [k for k in dict.fromkeys(sink_kinds) if k not in kinds]
+    for kind in null_mapped:
+        controller.watches(kind, mapper=_null_mapper)
     for kind in kinds:
         controller.watches(kind, mapper=_singleton_mapper)
     return controller

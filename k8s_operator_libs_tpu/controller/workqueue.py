@@ -27,7 +27,8 @@ import heapq
 import itertools
 import threading
 import time
-from typing import Dict, Hashable, List, Optional, Set, Tuple
+from collections import deque
+from typing import Deque, Dict, Hashable, List, Optional, Set, Tuple
 
 
 class ShutDown(Exception):
@@ -41,7 +42,10 @@ class WorkQueue:
 
     def __init__(self) -> None:
         self._cond = threading.Condition()
-        self._queue: List[Hashable] = []
+        # deque, not list: get() pops from the head, and list.pop(0)
+        # is O(n) — under a fleet-sized burst the queue alone would
+        # cost O(n²).
+        self._queue: Deque[Hashable] = deque()
         self._queued: Set[Hashable] = set()
         self._processing: Set[Hashable] = set()
         self._dirty: Set[Hashable] = set()
@@ -82,7 +86,7 @@ class WorkQueue:
                 if remaining is not None and remaining <= 0:
                     return None
                 self._cond.wait(remaining)
-            item = self._queue.pop(0)
+            item = self._queue.popleft()
             self._queued.discard(item)
             self._processing.add(item)
             enqueued = self._enqueued_at.pop(item, None)
@@ -115,6 +119,14 @@ class WorkQueue:
     def shutdown(self) -> None:
         with self._cond:
             self._shutting_down = True
+            # Queued items stay drainable (client-go: Get keeps handing
+            # out until empty after ShutDown), but per-item bookkeeping
+            # that only serves FUTURE adds/attribution is dropped now —
+            # a queue shut down with items still waiting must not pin
+            # their enqueue stamps (or dirty marks) for the rest of the
+            # process lifetime.
+            self._enqueued_at.clear()
+            self._dirty.clear()
             self._cond.notify_all()
 
     @property
@@ -157,6 +169,11 @@ class ExponentialBackoffRateLimiter:
         with self._lock:
             self._failures.pop(item, None)
 
+    def clear(self) -> None:
+        """Drop all failure history (queue shutdown)."""
+        with self._lock:
+            self._failures.clear()
+
 
 class RateLimitedQueue(WorkQueue):
     """WorkQueue + delayed adds + per-item backoff.  One background timer
@@ -198,7 +215,13 @@ class RateLimitedQueue(WorkQueue):
     def shutdown(self) -> None:
         super().shutdown()
         with self._delay_cond:
+            # Delayed items can never fire after shutdown (the timer
+            # thread exits and add() no-ops) — holding them, or the
+            # limiter's per-item failure history, would leak forever on
+            # a queue that outlives its controller.
+            self._heap.clear()
             self._delay_cond.notify_all()
+        self._limiter.clear()
 
     def pending_work(self) -> int:
         with self._delay_cond:

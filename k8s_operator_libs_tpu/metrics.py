@@ -421,6 +421,47 @@ def observe_reconcile(
     )
 
 
+def observe_build_state(
+    mode: str, seconds: float, trace_id: Optional[str] = None
+) -> None:
+    """BuildState latency, split by assembly mode: ``full`` (from-scratch
+    relist) vs ``incremental`` (journal-driven ClusterStateIndex) — the
+    A/B the index exists to win."""
+    default_registry().histogram(
+        "build_state_seconds",
+        "BuildState duration by assembly mode (full relist vs "
+        "incremental state index).",
+        ("mode",),
+    ).observe(
+        seconds,
+        mode,
+        exemplar={"trace_id": trace_id} if trace_id else None,
+    )
+
+
+def record_state_index_rebuild(reason: str) -> None:
+    """The ClusterStateIndex performed a FULL resync: initial seed,
+    journal expiry (the 410 Gone path), or an explicit relist."""
+    default_registry().counter(
+        "state_index_rebuilds_total",
+        "Full ClusterStateIndex resyncs, by reason "
+        "(seed | journal-expired | relist).",
+        ("reason",),
+    ).inc(reason)
+
+
+def record_state_index_fallback(reason: str) -> None:
+    """An indexed BuildState fell back to the from-scratch path
+    (scope mismatch, internal error) — steady growth means the index is
+    not earning its keep and should be investigated or disabled."""
+    default_registry().counter(
+        "state_index_fallbacks_total",
+        "Indexed BuildState calls served by the full-rebuild fallback, "
+        "by reason.",
+        ("reason",),
+    ).inc(reason)
+
+
 def record_drain(
     result: str, seconds: float, trace_id: Optional[str] = None
 ) -> None:

@@ -25,6 +25,7 @@ from .pod_manager import (
     PodManagerError,
 )
 from .safe_driver_load_manager import SafeDriverLoadManager
+from .state_index import ClusterStateIndex
 from .upgrade_inplace import InplaceNodeStateManager
 from .upgrade_requestor import (
     DEFAULT_NODE_MAINTENANCE_NAME_PREFIX,
@@ -62,6 +63,7 @@ __all__ = [
     "PodManagerConfig",
     "PodManagerError",
     "SafeDriverLoadManager",
+    "ClusterStateIndex",
     "InplaceNodeStateManager",
     "DEFAULT_NODE_MAINTENANCE_NAME_PREFIX",
     "NodeMaintenanceUpgradeDisabledError",

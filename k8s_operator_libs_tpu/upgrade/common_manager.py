@@ -80,9 +80,43 @@ class ClusterUpgradeState:
     """Point-in-time snapshot: state-label → node states (reference :69-75)."""
 
     node_states: Dict[str, List[NodeUpgradeState]] = field(default_factory=dict)
+    #: Node names whose snapshot inputs changed since the previous pass,
+    #: filled by the incremental BuildState
+    #: (:class:`~.state_index.ClusterStateIndex`).  ``None`` — the full
+    #: rebuild, a fresh index seed, or any caller that does not track
+    #: dirtiness — means "unknown: scan everything", which is the
+    #: pre-index behavior and the safe fallback.  Excluded from equality
+    #: (two snapshots with identical contents are the same snapshot no
+    #: matter how they were assembled).
+    dirty_nodes: Optional[set] = field(default=None, compare=False)
+    #: True when this snapshot was assembled by the ClusterStateIndex —
+    #: the manager then ACKs the index's dirty debt once an ApplyState
+    #: pass over it completes.  Excluded from equality like dirty_nodes.
+    built_from_index: bool = field(default=False, compare=False)
 
     def nodes_in(self, state: str) -> List[NodeUpgradeState]:
         return self.node_states.get(state, [])
+
+    def scan_scope(self, state: str) -> List[NodeUpgradeState]:
+        """The *dirty-scoped* view of a bucket: only entries whose node
+        inputs changed since the last pass, or the whole bucket when
+        dirtiness is unknown.  ONLY valid for processors whose verdict
+        is a pure function of the node's own event-visible inputs (its
+        node object, its pods, the DS revision oracle — all of which
+        feed the dirty set).  Processors with wall-clock behavior
+        (validation/wait-for-jobs timeouts), cross-node inputs (the
+        slice safe-load barrier), or async re-scheduling duties must
+        keep scanning their full — O(active), throttle-bounded —
+        buckets."""
+        entries = self.node_states.get(state, [])
+        if self.dirty_nodes is None:
+            return entries
+        dirty = self.dirty_nodes
+        return [
+            ns
+            for ns in entries
+            if ((ns.node.get("metadata") or {}).get("name") or "") in dirty
+        ]
 
     def all_node_states(self) -> List[NodeUpgradeState]:
         return [ns for states in self.node_states.values() for ns in states]
@@ -292,8 +326,16 @@ class CommonUpgradeManager:
         fleet every cycle (the steady-state done bucket), so the
         per-node span opens only around an actual transition — an
         always-on span per read-only check costs ~2× at 4,096 nodes for
-        spans nobody will ever look at."""
-        for node_state in state.nodes_in(state_name):
+        spans nobody will ever look at.
+
+        Scan scope: dirty-node-scoped when the snapshot carries a dirty
+        set (incremental BuildState) — a done/unknown node none of whose
+        inputs changed cannot flip its verdict (revision sync, safe-load
+        wait, and the upgrade-requested annotation are all event-visible
+        inputs that feed the dirty set; a DS/ControllerRevision publish
+        dirties the whole fleet), so only changed nodes are re-checked.
+        Full scan when dirtiness is unknown — the pre-index behavior."""
+        for node_state in state.scan_scope(state_name):
             node = node_state.node
             synced, orphaned = self.pod_in_sync_with_ds(node_state)
             requested = self.is_upgrade_requested(node)
@@ -455,8 +497,13 @@ class CommonUpgradeManager:
 
     def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
         """Self-healing of failed nodes once the pod is back in sync
-        (reference: ProcessUpgradeFailedNodes, :528-570)."""
-        for node_state in state.nodes_in(consts.UPGRADE_STATE_FAILED):
+        (reference: ProcessUpgradeFailedNodes, :528-570).
+
+        Dirty-scoped like the done/unknown scan: the failed bucket can
+        grow without bound (it holds nodes awaiting an out-of-band fix)
+        and the self-heal verdict is a pure function of the node's own
+        pod-vs-revision sync — event-visible inputs all."""
+        for node_state in state.scan_scope(consts.UPGRADE_STATE_FAILED):
             if not self.is_driver_pod_in_sync(node_state):
                 continue
             node = node_state.node

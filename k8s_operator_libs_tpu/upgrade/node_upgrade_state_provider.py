@@ -435,6 +435,12 @@ class NodeUpgradeStateProvider:
         Prefers the cache's copy-free rv probe — this runs once per
         write per poll tick, and a deep copy per tick serializes every
         reader on the backing store's lock at fleet scale."""
+        if getattr(self._cache, "always_fresh", False):
+            # Pass-through cache: our landed write IS the served state —
+            # probing the store per written node only queues the
+            # reconcile thread on the store lock behind the drain
+            # workers (profiled as the top cost of the 8k-node rollout).
+            return True
         peek = getattr(self._cache, "resource_version_of", None)
         if peek is not None:
             cached_rv = peek("Node", name)

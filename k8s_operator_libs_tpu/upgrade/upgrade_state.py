@@ -45,6 +45,7 @@ from .drain_manager import DrainManager, PreDrainGate
 from .node_upgrade_state_provider import NodeUpgradeStateProvider
 from .pod_manager import PodDeletionFilter, PodManager
 from .safe_driver_load_manager import SafeDriverLoadManager
+from .state_index import ClusterStateIndex
 from .upgrade_inplace import InplaceNodeStateManager
 from .util import EventRecorder, log_event
 from .validation_manager import ValidationManager
@@ -72,6 +73,8 @@ class ClusterUpgradeStateManager:
         write_pipeline_workers: int = 0,
         cache_sync_timeout_seconds: float = 10.0,
         cache_sync_poll_seconds: float = 1.0,
+        use_state_index: bool = False,
+        state_index: Optional[ClusterStateIndex] = None,
         # test injection points (the reference wires mocks the same way,
         # upgrade_suit_test.go:114-182)
         provider: Optional[NodeUpgradeStateProvider] = None,
@@ -166,6 +169,16 @@ class ClusterUpgradeStateManager:
         self._inplace: Optional[InplaceNodeStateManager] = None
         self._requestor = requestor
         self._use_maintenance_operator = use_maintenance_operator
+        #: Incremental BuildState (see :mod:`.state_index`): keep the
+        #: node→{node, driver-pod, DaemonSet} grouping resident and
+        #: advance it by the watch journal, so snapshot cost is
+        #: O(changed) instead of O(fleet).  Off by default — the full
+        #: rebuild is the reference behavior and the fallback; pass
+        #: ``use_state_index=True`` (the index is created lazily, bound
+        #: to the first build's namespace/labels scope) or inject a
+        #: pre-built/externally-fed *state_index*.
+        self._use_state_index = use_state_index or state_index is not None
+        self._state_index = state_index
 
     def shutdown(self, wait: bool = True) -> None:
         """Release the worker-pool threads this manager owns.  Long-lived
@@ -267,28 +280,97 @@ class ClusterUpgradeStateManager:
         return self._requestor
 
     # ------------------------------------------------------------ BuildState
+    @property
+    def state_index(self) -> Optional[ClusterStateIndex]:
+        """The incremental-BuildState index, when enabled (None in full
+        mode).  Created lazily on the first indexed build."""
+        return self._state_index
+
     def build_state(
         self, namespace: str, driver_labels: Dict[str, str]
     ) -> ClusterUpgradeState:
-        """Snapshot construction (reference: BuildState, :99-164)."""
+        """Snapshot construction (reference: BuildState, :99-164) —
+        from-scratch, or assembled O(changed) from the journal-driven
+        :class:`~.state_index.ClusterStateIndex` when enabled."""
         started = time.monotonic()
+        index = self._index_for(namespace, driver_labels)
+        # mutable: the indexed path downgrades to "full" when its
+        # internal-error fallback ends up serving a full rebuild — the
+        # histogram must label what actually ran, or a persistently
+        # failing index would fill the incremental series with
+        # full-rebuild latencies and flatten the A/B it exists to show
+        mode = {"v": "full" if index is None else "incremental"}
         with tracing.start_span(
-            "BuildState", attributes={"namespace": namespace}
+            "BuildState", attributes={"namespace": namespace, "mode": mode["v"]}
         ) as span:
             try:
+                if index is not None:
+                    state = self._build_state_indexed(index)
+                    if not state.built_from_index:
+                        mode["v"] = "full"
+                        span.set_attribute("mode", "full")
+                    return state
                 return self._build_state(namespace, driver_labels)
             finally:
                 # finally: failed snapshots are exactly the slow outliers
                 # the latency histogram exists to surface
+                elapsed = time.monotonic() - started
                 metrics.observe_reconcile(
-                    "build", time.monotonic() - started,
-                    trace_id=span.trace_id,
+                    "build", elapsed, trace_id=span.trace_id
+                )
+                metrics.observe_build_state(
+                    mode["v"], elapsed, trace_id=span.trace_id
                 )
 
-    def _build_state(
+    def _index_for(
         self, namespace: str, driver_labels: Dict[str, str]
+    ) -> Optional[ClusterStateIndex]:
+        """The index serving this build, or None for the full path.
+        The index is scope-bound: a build for a different namespace /
+        label set (multi-scope embedders) falls back to the full
+        rebuild rather than serving a wrong-scope snapshot."""
+        if not self._use_state_index:
+            return None
+        if self._state_index is None:
+            self._state_index = ClusterStateIndex(
+                self._cluster, namespace, dict(driver_labels)
+            )
+        index = self._state_index
+        if (
+            index.namespace != namespace
+            or index.driver_labels != dict(driver_labels)
+        ):
+            metrics.record_state_index_fallback("scope-mismatch")
+            return None
+        return index
+
+    def _build_state_indexed(
+        self, index: ClusterStateIndex
     ) -> ClusterUpgradeState:
-        common = self.common
+        _ = self.common  # managers assembled (parity with the full path)
+        self._reset_revision_memo()
+        index.set_requestor(self._requestor)
+        try:
+            state, dirty = index.build_state()
+        except UpgradeStateError:
+            raise  # parity errors (unscheduled pods, missing node)
+        except Exception as err:  # noqa: BLE001 — availability over purity
+            # An index-internal failure must never take BuildState down:
+            # serve this cycle from the full rebuild, force the index
+            # through a reseed, and count the fallback so steady growth
+            # is visible on /metrics.
+            logger.error(
+                "state index build failed (%s); falling back to full "
+                "rebuild", err,
+            )
+            metrics.record_state_index_fallback("error")
+            index.invalidate()
+            return self._build_state(index.namespace, index.driver_labels)
+        state.dirty_nodes = dirty
+        state.built_from_index = True
+        return state
+
+    def _reset_revision_memo(self) -> None:
         # fresh cycle: the DS-revision oracle re-reads ControllerRevisions
         # once, then every per-node sync check this cycle hits the memo.
         # Clearing it is load-bearing on the real manager (a stale entry
@@ -301,6 +383,12 @@ class ClusterUpgradeStateManager:
             self.pod_manager.reset_revision_memo()
         else:
             getattr(self.pod_manager, "reset_revision_memo", lambda: None)()
+
+    def _build_state(
+        self, namespace: str, driver_labels: Dict[str, str]
+    ) -> ClusterUpgradeState:
+        common = self.common
+        self._reset_revision_memo()
         state = ClusterUpgradeState()
         daemon_sets = common.get_driver_daemon_sets(namespace, driver_labels)
         pods = self._reader.list(
@@ -401,6 +489,9 @@ class ClusterUpgradeStateManager:
             # a paused rollout must not leave upgrades_in_progress frozen
             # at its last active value (alerts would fire forever).
             self._publish_gauges(common, state)
+            # No ack_dirty: a paused pass never processed the snapshot's
+            # dirty view, so the index keeps it as scan debt and the
+            # scoped scans revisit those nodes once the rollout resumes.
             logger.info("auto upgrade is disabled, skipping")
             return
         if getattr(self._safe_load_manager, "slice_coherent", False):
@@ -434,6 +525,13 @@ class ClusterUpgradeStateManager:
         ) as span:
             try:
                 self._apply_state(common, state, policy)
+                # Pass completed: the dirty view this snapshot carried
+                # has been processed — settle the index's scan debt.
+                # An aborted pass (cache-sync timeout, processor error)
+                # skips this, so the next builds keep re-scoping the
+                # unprocessed names and no input change is dropped.
+                if state.built_from_index and self._state_index is not None:
+                    self._state_index.ack_dirty()
             finally:
                 # finally: an aborted reconcile (e.g. cache-sync timeout) is
                 # the latency outlier the histogram must not silently drop

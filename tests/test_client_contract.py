@@ -2652,16 +2652,22 @@ class TestClientSideThrottle:
         store = InMemoryCluster()
         store.create(make_node("n1"))
         with ApiServerFacade(store) as facade:
+            # qps low enough that the pacing window (0.75 s) dwarfs
+            # per-request wall overhead on a loaded machine — with the
+            # old 50 qps the 0.2 s window was comparable to 15 slow
+            # HTTP round trips, and tokens refilled during them pushed
+            # the recorded bucket wait under the assertion (flaked
+            # whenever the box was busy)
             client = KubeApiClient(
-                KubeConfig(server=facade.url, qps=50.0, burst=5), timeout=10.0
+                KubeConfig(server=facade.url, qps=20.0, burst=5), timeout=10.0
             )
             t0 = time.monotonic()
-            for _ in range(15):
+            for _ in range(20):
                 client.get("Node", "n1")
             elapsed = time.monotonic() - t0
-        # 5 ride the burst; 10 refill at 50/s => >= 0.2 s of pacing
-        assert elapsed >= 0.18, f"no pacing observed ({elapsed:.3f}s)"
-        assert client.throttle_waited_seconds >= 0.15
+        # 5 ride the burst; 15 refill at 20/s => >= 0.75 s of pacing
+        assert elapsed >= 0.7, f"no pacing observed ({elapsed:.3f}s)"
+        assert client.throttle_waited_seconds >= 0.3
 
     def test_burst_rides_free(self):
         store = InMemoryCluster()
@@ -3095,8 +3101,13 @@ class TestOverloadedThrottledRollout:
         store.list = slow_list
         facade = ApiServerFacade(store, max_inflight=1).with_chaos(0.03)
         facade.start()
+        # qps/burst sized so the rollout's OWN traffic overruns the
+        # bucket: the provider's always-fresh cache no longer issues
+        # per-write visibility polls (cache.py `always_fresh`), so the
+        # old 300 qps budget was never exceeded and the throttle layer
+        # sat vacuously idle
         client = KubeApiClient(
-            KubeConfig(server=facade.url, qps=300.0, burst=30),
+            KubeConfig(server=facade.url, qps=60.0, burst=10),
             timeout=10.0,
         )
         try:

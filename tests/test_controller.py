@@ -88,6 +88,58 @@ class TestWorkQueue:
         q.add("a")
         assert len(q) == 0
 
+    def test_queue_is_deque_backed(self):
+        """Regression pin for the O(n) pop: get() must pop from a deque
+        head, not a list (list.pop(0) made a fleet-sized burst cost
+        O(n²) in the queue alone)."""
+        from collections import deque
+
+        q = WorkQueue()
+        assert isinstance(q._queue, deque)
+        for i in range(100):
+            q.add(i)
+        assert [q.get(0.1) for _ in range(100)] == list(range(100))
+
+    def test_drain_pops_enqueue_bookkeeping(self):
+        """Every drained item drops its enqueue stamp; done() drops the
+        wait attribution — nothing accumulates across the lifecycle."""
+        q = WorkQueue()
+        for i in range(5):
+            q.add(i)
+        for _ in range(5):
+            item = q.get(0.1)
+            assert item not in q._enqueued_at
+            assert q.queue_wait(item) is not None
+            q.done(item)
+            assert item not in q._last_wait
+        assert q._enqueued_at == {}
+        assert q._last_wait == {}
+
+    def test_shutdown_clears_bookkeeping_for_queued_items(self):
+        """shutdown() with items still queued must not pin their
+        metadata forever — queued items stay drainable, but enqueue
+        stamps, dirty marks, the delay heap, and the limiter's failure
+        history are dropped."""
+        q = RateLimitedQueue(ExponentialBackoffRateLimiter(base_delay=30.0))
+        q.add("queued-1")
+        q.add("queued-2")
+        processing = q.get(0.1)  # "queued-1" now processing
+        q.add(processing)  # dirty while processing
+        q.add_after("delayed", 30.0)  # would fire long after shutdown
+        q.add_rate_limited("failing")  # limiter failure history, 30s delay
+        q.shutdown()
+        assert q._enqueued_at == {}
+        assert q._dirty == set()
+        assert q._heap == []
+        assert q.num_requeues("failing") == 0
+        # drain semantics preserved: the still-queued item is handed
+        # out, then ShutDown
+        assert q.get(0.1) == "queued-2"
+        q.done("queued-2")
+        q.done(processing)
+        with pytest.raises(ShutDown):
+            q.get(0.1)
+
 
 class TestRateLimiting:
     def test_backoff_doubles_and_caps(self):
