@@ -11,7 +11,7 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 
 .PHONY: all test test-fast lint bench bench-scale smoke graft-check cov \
 	cov-report clean help image .build-image kind-e2e kind-e2e-stub \
-	tpu-smoke tpu-probe tpu-watch tpu-stage verify-obs
+	tpu-smoke tpu-probe tpu-watch tpu-stage verify-obs verify-remediation
 
 # Enforced coverage floor (VERDICT r4 next #6).  Full-suite line
 # coverage measured by the zero-dependency sys.monitoring tracer
@@ -44,6 +44,14 @@ test-fast:
 verify-obs:
 	$(PYTHON) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors
 	$(PYTHON) -m k8s_operator_libs_tpu traces --selftest
+
+# Remediation gate: the breaker/LKG-rollback/retry-budget suite (unit +
+# convergence properties incl. crash-resume mid-rollback) plus the
+# in-process breaker selftest (trip → rollback → converge-on-LKG).
+verify-remediation:
+	$(PYTHON) -m pytest tests/test_remediation.py \
+		"tests/test_resilience.py::TestRemediationConvergence" -q
+	$(PYTHON) -m k8s_operator_libs_tpu remediation --selftest
 
 lint:
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu examples bench.py __graft_entry__.py

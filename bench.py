@@ -584,6 +584,87 @@ def scale_section(tuned_policy: UpgradePolicySpec) -> dict:
     }
 
 
+def remediation_section(slices: int = 256, hosts: int = 4) -> dict:
+    """Rollback MTTR at 1,024 nodes: a bad revision whose pods all come
+    up storming is injected mid-steady-state with the remediation engine
+    armed (autoRollback).  Measures (a) time from the bad publish to the
+    breaker trip and (b) **rollback_mttr** — time from the trip to the
+    whole fleet back on the last-known-good revision (the acceptance
+    metric of the remediation subsystem).  Runs under the operator GC
+    profile + incremental state index, like the deployed entrypoints."""
+    from k8s_operator_libs_tpu.api import RemediationSpec
+
+    cluster = InMemoryCluster()
+    fleet = Fleet(cluster, revision_hash="rev1")
+    for s in range(slices):
+        for h in range(hosts):
+            fleet.add_node(
+                f"s{s:03d}-h{h}",
+                labels={consts.SLICE_ID_LABEL_KEYS[0]: f"sl-{s:03d}"},
+            )
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("25%"),
+        slice_aware=True,
+        drain_spec=DrainSpec(enable=True, force=True, timeout_second=60),
+        remediation=RemediationSpec(
+            failure_threshold=0.25,
+            min_attempted=8,
+            auto_rollback=True,
+            max_node_attempts=10,
+            backoff_seconds=0.0,
+        ),
+    )
+    cache = InformerCache(cluster, lag_seconds=0.0)
+    manager = ClusterUpgradeStateManager(
+        cluster,
+        cache=cache,
+        cascade=True,
+        use_state_index=True,
+        cache_sync_timeout_seconds=5.0,
+        cache_sync_poll_seconds=0.005,
+    )
+
+    def reconcile() -> None:
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+        manager.apply_state(state, policy)
+        manager.drain_manager.wait_idle(30.0)
+        manager.pod_manager.wait_idle(30.0)
+        fleet.reconcile_daemonset()
+
+    nodes = slices * hosts
+    with tuned_gc():
+        try:
+            for _ in range(5):  # healthy era: LKG records rev1
+                reconcile()
+            fleet.bad_revisions.add("rev2")
+            fleet.publish_new_revision("rev2")
+            published = time.monotonic()
+            tripped_at = None
+            for _ in range(4000):
+                reconcile()
+                if tripped_at is None and (
+                    (manager.remediation_status() or {}).get("breaker")
+                ):
+                    tripped_at = time.monotonic()
+                if (
+                    tripped_at is not None
+                    and fleet.revision_hash == "rev1"
+                    and fleet.all_done()
+                ):
+                    recovered = time.monotonic()
+                    break
+            else:
+                raise RuntimeError("rollback did not converge")
+        finally:
+            manager.shutdown()
+    return {
+        f"rollback_mttr_s_{nodes}n": round(recovered - tripped_at, 2),
+        f"rollback_trip_s_{nodes}n": round(tripped_at - published, 2),
+    }
+
+
 def bench_policies() -> tuple:
     """(reference-defaults policy, tuned slice-aware policy) — ONE
     definition shared by the headline bench and ``--profile`` so the
@@ -663,6 +744,9 @@ def main() -> None:
     # default-GC and full-rebuild A/Bs kept honest).
     scale = scale_section(tuned_policy)
 
+    # ---- remediation: breaker-trip → LKG-rollback MTTR at 1,024 nodes
+    remediation = remediation_section()
+
     # ---- HTTP path: the production loop over real localhost HTTP with
     # server-enforced pages and held watch streams — the 48-node lagged
     # fleet (20-item pages, r4 continuity) AND the 1,024-node probe
@@ -711,6 +795,7 @@ def main() -> None:
                     "fleet": f"{SLICES}x{HOSTS_PER_SLICE}-host slices",
                     "inmem_nodes_per_min": round(tuned_rate, 2),
                     **scale,
+                    **remediation,
                     "engine": {
                         "speedup_full_vs_all_off": round(
                             engine_all_off_s / engine_full_s, 3
@@ -846,7 +931,7 @@ def scale_main() -> None:
     scale work runs in a fraction of the full bench's wall clock."""
     util.set_component_name("tpu-runtime")
     _, tuned_policy = bench_policies()
-    detail = scale_section(tuned_policy)
+    detail = {**scale_section(tuned_policy), **remediation_section()}
     result = {
         "metric": "scale_4096_nodes_per_min",
         "value": detail["scale_4096_nodes_per_min"],

@@ -30,7 +30,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
 
 from k8s_operator_libs_tpu import metrics
-from k8s_operator_libs_tpu.api import DrainSpec, IntOrString, UpgradePolicySpec
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    RemediationSpec,
+    UpgradePolicySpec,
+)
 from k8s_operator_libs_tpu.cluster import InMemoryCluster
 from k8s_operator_libs_tpu.controller import new_upgrade_controller
 from k8s_operator_libs_tpu.runtime import tune_gc
@@ -161,14 +166,21 @@ def run_real(args) -> int:
         # "-"), correlating log lines with /debug/traces and the
         # histogram exemplars — see docs/observability.md
         tracing.install_trace_logging()
-        ops = OpsServer(port=args.ops_port, host=args.ops_host).start()
+        ops = OpsServer(
+            port=args.ops_port,
+            host=args.ops_host,
+            # breaker/LKG/quarantine state for operators debugging a
+            # paused or rolling-back fleet (decision is null until the
+            # first remediation-enabled reconcile publishes one)
+            remediation_source=manager.remediation_status,
+        ).start()
         ops.add_health_check("controller", runnable.running)
         # A hot HA standby is READY (it serves its purpose: being able
         # to take over); readiness only fails when threads died.
         ops.add_ready_check("replica", runnable.running)
         print(
             f"ops endpoints on {ops.url} "
-            "(/metrics /healthz /readyz /debug/traces)"
+            "(/metrics /healthz /readyz /debug/traces /debug/remediation)"
         )
     started = False
     try:
@@ -350,6 +362,10 @@ def run_demo() -> int:
                 max_unavailable=IntOrString("34%"),  # 1 of 3 slices at a time
                 slice_aware=True,
                 drain_spec=DrainSpec(enable=True, force=True, timeout_second=60),
+                # detect->decide->recover loop armed: a bad revision that
+                # fails half the attempted nodes trips the breaker and
+                # rolls the fleet back to the last-known-good revision
+                remediation=RemediationSpec(auto_rollback=True),
             ).to_dict(),
         }
     )

@@ -422,6 +422,54 @@ def cmd_traces(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_remediation(args: argparse.Namespace) -> int:
+    """Inspect the remediation engine: breaker state, last-known-good
+    records, per-node retry budgets and quarantines — offline from a
+    dump or live.  ``--selftest`` runs the in-memory breaker/rollback
+    smoke end-to-end (the ``make verify-remediation`` gate)."""
+    if args.selftest:
+        from .upgrade import remediation as remediation_mod
+
+        try:
+            print(remediation_mod.selftest())
+        except AssertionError as err:
+            print(f"remediation selftest FAILED: {err}", file=sys.stderr)
+            return 1
+        return 0
+    cluster, rc = _open_source(args, "remediation")
+    if cluster is None:
+        return rc
+    util.set_component_name(args.component)
+    from .cluster.errors import ApiError
+    from .upgrade.remediation import remediation_report, render_report
+    from .upgrade.upgrade_state import UpgradeStateError
+
+    policy, prc, pmsg = _load_policy_cr(args, cluster)
+    if pmsg:
+        print(pmsg, file=sys.stderr)
+    if prc:
+        return prc
+    if policy is not None:
+        _push_topology_keys(policy)
+    manager = ClusterUpgradeStateManager(cluster)
+    try:
+        state = manager.build_state(
+            args.namespace, _parse_selector_arg(args.selector)
+        )
+    except (ApiError, OSError, UpgradeStateError) as err:
+        print(f"cannot read cluster state: {err}", file=sys.stderr)
+        return 2
+    finally:
+        manager.shutdown()
+    report = remediation_report(state, policy=policy)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render_report(report))
+    # poll-friendly: nonzero while the breaker blocks admissions
+    return 3 if (report.get("blocking") and args.wait_exit_code) else 0
+
+
 def cmd_repair(args: argparse.Namespace) -> int:
     """Codify the upgrade-failed runbook: delete a failed node's driver
     pod so the DaemonSet recreates it at the target revision and the
@@ -707,6 +755,33 @@ def main(argv=None) -> int:
         "both exporters) and exit 0/1 — the make verify-obs smoke",
     )
     tr.set_defaults(func=cmd_traces)
+
+    rm = sub.add_parser(
+        "remediation",
+        help="inspect the remediation engine: breaker state, last-known-"
+        "good records, per-node retry budgets and quarantines; "
+        "--selftest smokes the breaker/rollback loop end-to-end",
+    )
+    _add_source_args(rm)
+    _add_query_args(rm)
+    rm.add_argument(
+        "--policy",
+        default="",
+        help="TpuUpgradePolicy name in the source (annotates whether the "
+        "engine is enabled; the records render either way)",
+    )
+    rm.add_argument(
+        "--wait-exit-code",
+        action="store_true",
+        help="exit 3 while the breaker blocks admissions (poll-friendly)",
+    )
+    rm.add_argument(
+        "--selftest",
+        action="store_true",
+        help="run the in-memory breaker trip + LKG rollback smoke and "
+        "exit 0/1 — the make verify-remediation gate (no source needed)",
+    )
+    rm.set_defaults(func=cmd_remediation)
 
     rp = sub.add_parser(
         "repair",

@@ -6,6 +6,7 @@ from .upgrade_spec import (
     DrainSpec,
     PodDeletionSpec,
     PreDrainCheckpointSpec,
+    RemediationSpec,
     UpgradePolicySpec,
     ValidationError,
     ValidationSpec,
@@ -18,6 +19,7 @@ __all__ = [
     "DrainSpec",
     "PodDeletionSpec",
     "PreDrainCheckpointSpec",
+    "RemediationSpec",
     "UpgradePolicySpec",
     "ValidationError",
     "ValidationSpec",

@@ -325,6 +325,91 @@ class PreDrainCheckpointSpec:
 
 
 @dataclass
+class RemediationSpec:
+    """Automated recovery policy: failure-budget circuit breaker,
+    last-known-good rollback, and per-node retry budgets (extension; the
+    reference stops at detection — a failed canary freezes the rollout
+    and failed nodes wait for out-of-band repair).
+
+    The breaker trips when, among nodes *attempted* on the current
+    target revision inside the trailing ``window_seconds``,
+    the failure ratio — upgrade-failed nodes plus upgrade-done nodes
+    whose TPU health degraded post-upgrade — reaches
+    ``failure_threshold``.  A tripped breaker pauses fresh admissions
+    (the ``remediation`` gate) and, with ``auto_rollback``, reverts the
+    DaemonSet to the recorded last-known-good ControllerRevision so the
+    normal state machine drives the fleet back.
+    """
+
+    #: Fraction of attempted nodes that may fail before the breaker
+    #: trips (0 < threshold <= 1).
+    failure_threshold: float = 0.25
+    #: Minimum attempted nodes before the ratio is meaningful — a
+    #: 1-node fleet must not trip on its first failure.
+    min_attempted: int = 3
+    #: Sliding census window (seconds) for attempts/failures.
+    window_seconds: float = 3600.0
+    #: On trip, revert the DaemonSet to the last-known-good revision
+    #: automatically (default: pause only and wait for a human).
+    auto_rollback: bool = False
+    #: Per-node upgrade attempts before the node's domain is
+    #: quarantined (taint + annotation); 0 disables the retry budget.
+    max_node_attempts: int = 3
+    #: Base of the per-node exponential retry backoff (seconds):
+    #: attempt k waits ``backoff_seconds * 2**(k-1)`` after its failure.
+    backoff_seconds: float = 60.0
+    #: Backoff ceiling (seconds).
+    backoff_max_seconds: float = 3600.0
+
+    def validate(self) -> None:
+        _require_bool("remediation.autoRollback", self.auto_rollback)
+        if not (0.0 < float(self.failure_threshold) <= 1.0):
+            raise ValidationError(
+                "remediation.failureThreshold must be in (0, 1], got "
+                f"{self.failure_threshold!r}"
+            )
+        _require_non_negative("remediation.minAttempted", self.min_attempted)
+        _require_non_negative("remediation.maxNodeAttempts", self.max_node_attempts)
+        _require_non_negative("remediation.backoffSeconds", self.backoff_seconds)
+        _require_non_negative(
+            "remediation.backoffMaxSeconds", self.backoff_max_seconds
+        )
+        if self.window_seconds <= 0:
+            raise ValidationError(
+                "remediation.windowSeconds must be > 0, got "
+                f"{self.window_seconds!r}"
+            )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "failureThreshold": self.failure_threshold,
+            "minAttempted": self.min_attempted,
+            "windowSeconds": self.window_seconds,
+        }
+        if self.auto_rollback:
+            out["autoRollback"] = True
+        if self.max_node_attempts != 3:
+            out["maxNodeAttempts"] = self.max_node_attempts
+        if self.backoff_seconds != 60.0:
+            out["backoffSeconds"] = self.backoff_seconds
+        if self.backoff_max_seconds != 3600.0:
+            out["backoffMaxSeconds"] = self.backoff_max_seconds
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RemediationSpec":
+        return cls(
+            failure_threshold=d.get("failureThreshold", 0.25),
+            min_attempted=d.get("minAttempted", 3),
+            window_seconds=d.get("windowSeconds", 3600.0),
+            auto_rollback=d.get("autoRollback", False),
+            max_node_attempts=d.get("maxNodeAttempts", 3),
+            backoff_seconds=d.get("backoffSeconds", 60.0),
+            backoff_max_seconds=d.get("backoffMaxSeconds", 3600.0),
+        )
+
+
+@dataclass
 class UpgradePolicySpec:
     """Policy for automatic component upgrades across the fleet.
 
@@ -383,6 +468,10 @@ class UpgradePolicySpec:
     #: node_upgrade_state_provider.go:100-117).  0 = keep the manager's
     #: constructor value.
     cache_sync_timeout_second: float = 0
+    #: Automated recovery: failure-budget breaker, LKG rollback, per-node
+    #: retry budgets (see :class:`RemediationSpec`).  None disables the
+    #: remediation engine entirely (reference behavior).
+    remediation: Optional[RemediationSpec] = None
 
     def __post_init__(self) -> None:
         if isinstance(self.max_unavailable, (int, str)):
@@ -430,6 +519,7 @@ class UpgradePolicySpec:
             self.drain_spec,
             self.pre_drain_checkpoint,
             self.validation,
+            self.remediation,
         ):
             if sub is not None:
                 sub.validate()
@@ -472,6 +562,8 @@ class UpgradePolicySpec:
             out["multisliceLabelKeys"] = list(self.multislice_label_keys)
         if self.cache_sync_timeout_second:
             out["cacheSyncTimeoutSeconds"] = self.cache_sync_timeout_second
+        if self.remediation is not None:
+            out["remediation"] = self.remediation.to_dict()
         return out
 
     @classmethod
@@ -519,4 +611,9 @@ class UpgradePolicySpec:
             slice_label_keys=tuple(d.get("sliceLabelKeys") or ()),
             multislice_label_keys=tuple(d.get("multisliceLabelKeys") or ()),
             cache_sync_timeout_second=d.get("cacheSyncTimeoutSeconds", 0),
+            remediation=(
+                RemediationSpec.from_dict(d["remediation"])
+                if d.get("remediation") is not None
+                else None
+            ),
         )

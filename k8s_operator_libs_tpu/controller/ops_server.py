@@ -18,7 +18,10 @@ server exposing
 * ``GET /debug/traces`` — recent completed reconcile traces from the
   process tracer (:mod:`..obs.tracing`), OTLP-flavoured JSON by default;
   ``?fmt=chrome`` renders ``chrome://tracing`` JSON, ``?fmt=native`` the
-  raw span dicts, ``?trace_id=...`` filters to one trace.
+  raw span dicts, ``?trace_id=...`` filters to one trace;
+* ``GET /debug/remediation`` — the remediation engine's latest decision
+  (breaker state, LKG records, quarantines) when a *remediation_source*
+  was wired (usually ``manager.remediation_status``); 404 otherwise.
 
 ``/metrics`` also honors ``Accept: application/openmetrics-text`` with
 the OpenMetrics rendering, whose histogram ``+Inf`` bucket lines carry
@@ -73,6 +76,7 @@ class OpsServer:
         host: str = "0.0.0.0",
         registry: Optional[metrics_mod.MetricsRegistry] = None,
         tracer: Optional[tracing_mod.Tracer] = None,
+        remediation_source: Optional[Callable[[], Optional[dict]]] = None,
     ) -> None:
         # All-interfaces default, like controller-runtime's metrics/probe
         # listeners: kubelet probes and Prometheus scrapes arrive on the
@@ -81,6 +85,9 @@ class OpsServer:
         self._requested_port = port
         self._registry = registry
         self._tracer = tracer
+        #: Callable returning the remediation engine's latest decision
+        #: dict (None = no pass yet); absent means the endpoint 404s.
+        self._remediation_source = remediation_source
         self._health_checks: Dict[str, Check] = {}
         self._ready_checks: Dict[str, Check] = {}
         self._lock = threading.Lock()
@@ -183,6 +190,20 @@ class OpsServer:
             )
         if path == "/debug/traces":
             return self._render_traces(parse_qs(raw_query))
+        if path == "/debug/remediation":
+            if self._remediation_source is None:
+                return (
+                    404,
+                    "text/plain; charset=utf-8",
+                    b"remediation not configured\n",
+                )
+            status = self._remediation_source()
+            payload = {"configured": True, "decision": status}
+            return (
+                200,
+                "application/json",
+                (json.dumps(payload) + "\n").encode(),
+            )
         return 404, "text/plain; charset=utf-8", b"404 not found\n"
 
     def start(self) -> "OpsServer":

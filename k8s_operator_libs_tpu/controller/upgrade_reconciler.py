@@ -144,7 +144,18 @@ class UpgradeReconciler:
             span.set_attribute(
                 "transitions", self.manager.last_apply_transitions
             )
-        if common.get_upgrades_in_progress(state):
+        # Failed nodes sit in an active-state bucket (they pin throttle
+        # slots — common_manager.go:730-737) but they are NOT in-flight
+        # work: nothing completes for them until an external fix or the
+        # remediation engine's backoff expires.  Counting them as active
+        # made the failed-only branch below unreachable and hot-looped a
+        # failed-only fleet at the active cadence — with the remediation
+        # retry budget (whose backoffs are minutes) that poll would do
+        # ~20 no-op fleet snapshots per second for the whole wait.
+        in_flight = common.get_upgrades_in_progress(
+            state
+        ) - common.get_upgrades_failed(state)
+        if in_flight > 0:
             return Result(requeue_after=self.active_requeue_seconds)
         if self.manager.last_apply_transitions:
             # The pass just MOVED nodes (e.g. admitted a wave): the

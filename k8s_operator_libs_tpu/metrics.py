@@ -576,6 +576,46 @@ def set_held_queue_depth(depth: int) -> None:
     ).set(depth)
 
 
+def publish_remediation_gauges(
+    breaker_open: bool, quarantined_nodes: int
+) -> None:
+    """Remediation-engine state: breaker position (1 = open/tripped,
+    0 = closed) and how many nodes the retry budget has quarantined."""
+    reg = default_registry()
+    reg.gauge(
+        "remediation_breaker_state",
+        "Failure-budget circuit breaker position (0 closed, 1 open).",
+    ).set(1 if breaker_open else 0)
+    reg.gauge(
+        "quarantined_nodes",
+        "Nodes quarantined by the remediation retry budget.",
+    ).set(quarantined_nodes)
+
+
+def record_breaker_trip() -> None:
+    """The failure-budget breaker tripped (admissions paused)."""
+    default_registry().counter(
+        "remediation_breaker_trips_total",
+        "Failure-budget circuit breaker trips.",
+    ).inc()
+
+
+def record_rollback() -> None:
+    """An automatic last-known-good rollback was initiated."""
+    default_registry().counter(
+        "rollbacks_total",
+        "Automatic last-known-good DaemonSet rollbacks initiated.",
+    ).inc()
+
+
+def record_node_quarantine() -> None:
+    """A node exhausted its retry budget and was quarantined."""
+    default_registry().counter(
+        "node_quarantines_total",
+        "Nodes quarantined after exhausting the upgrade retry budget.",
+    ).inc()
+
+
 def record_leader_transition(event: str) -> None:
     """Leader-election lifecycle: acquired | lost | released."""
     default_registry().counter(

@@ -105,12 +105,27 @@ class SliceHealthManager:
         nodes = self._cluster.list("Node")
         bad_domains = degraded_domains(nodes)
         by_domain: Dict[str, List[JsonObj]] = topology.group_by_domain(nodes)
+        from ..upgrade import consts as upgrade_consts
+
         for domain, members in by_domain.items():
             quarantined = domain in bad_domains
             for node in members:
                 annotations = (node.get("metadata") or {}).get("annotations") or {}
-                has = key in annotations
-                if quarantined and not has:
+                # Health-owned quarantines carry a bare domain id;
+                # remediation-owned ones (retry budget exhausted, see
+                # upgrade/remediation.py) are prefixed and must survive a
+                # clean health probe — the node fails UPGRADES, not
+                # health, and only the remediation release path may lift
+                # them.  A health-owned value is managed regardless of
+                # WHICH domain it names: after a re-slicing the stale
+                # value must still be lifted/re-stamped, not orphaned.
+                value = annotations.get(key)
+                remediation_owned = (value or "").startswith(
+                    upgrade_consts.REMEDIATION_QUARANTINE_PREFIX
+                )
+                if remediation_owned:
+                    continue
+                if quarantined and value != domain:
                     self._cluster.patch(
                         "Node",
                         node["metadata"]["name"],
@@ -123,7 +138,7 @@ class SliceHealthManager:
                         util.get_event_reason(),
                         f"Quarantined: domain {domain} has a degraded TPU host",
                     )
-                elif not quarantined and has:
+                elif not quarantined and value is not None:
                     self._cluster.patch(
                         "Node",
                         node["metadata"]["name"],

@@ -24,6 +24,12 @@ from .pod_manager import (
     PodManagerConfig,
     PodManagerError,
 )
+from .remediation import (
+    RemediationDecision,
+    RemediationManager,
+    remediation_report,
+    render_report,
+)
 from .safe_driver_load_manager import SafeDriverLoadManager
 from .state_index import ClusterStateIndex
 from .upgrade_inplace import InplaceNodeStateManager
@@ -62,6 +68,10 @@ __all__ = [
     "PodManager",
     "PodManagerConfig",
     "PodManagerError",
+    "RemediationDecision",
+    "RemediationManager",
+    "remediation_report",
+    "render_report",
     "SafeDriverLoadManager",
     "ClusterStateIndex",
     "InplaceNodeStateManager",

@@ -517,10 +517,29 @@ class CommonUpgradeManager:
                     self.provider.change_node_upgrade_annotation(
                         node, initial_key, consts.NULL_STRING
                     )
+                    new_state = consts.UPGRADE_STATE_DONE
                 else:
                     self.provider.change_node_upgrade_state(
                         node, consts.UPGRADE_STATE_UNCORDON_REQUIRED
                     )
+                    new_state = consts.UPGRADE_STATE_UNCORDON_REQUIRED
+                # A self-heal closes any open remediation failure episode
+                # (the retry budget resets on success) and — unlike the
+                # reference, whose silent recovery left no trace — is
+                # announced on the node's event timeline.
+                failure_at_key = util.get_last_failure_at_annotation_key()
+                if failure_at_key in annotations:
+                    self.provider.change_node_upgrade_annotation(
+                        node, failure_at_key, consts.NULL_STRING
+                    )
+                log_event(
+                    self.recorder,
+                    name_of(node),
+                    "Normal",
+                    util.get_event_reason(),
+                    "Upgrade failure self-healed: driver pod back in sync "
+                    f"at the target revision; node moves to {new_state}",
+                )
 
     def process_validation_required_nodes(self, state: ClusterUpgradeState) -> None:
         """Reference: ProcessValidationRequiredNodes (:573-604)."""

@@ -160,6 +160,54 @@ UPGRADE_QUARANTINE_ANNOTATION_KEY_FMT = DOMAIN + "/%s-upgrade.quarantine"
 #: requestor (maintenance-operator) mode (reference: util.go:134-138).
 UPGRADE_REQUESTOR_MODE_ANNOTATION_KEY_FMT = DOMAIN + "/%s-upgrade.requestor-mode"
 
+# ---- remediation engine (upgrade/remediation.py) --------------------------
+
+#: DaemonSet annotation recording the last-known-good ControllerRevision
+#: hash plus the currently observed target hash (JSON).  Written by the
+#: RemediationManager the first time a new target revision is observed;
+#: the recorded pre-rollout hash is what autoRollback reverts to.
+UPGRADE_LAST_KNOWN_GOOD_ANNOTATION_KEY_FMT = (
+    DOMAIN + "/%s-upgrade.last-known-good"
+)
+
+#: DaemonSet annotation holding the failure-budget breaker record
+#: (JSON: state, target, trippedAt, failures/attempted, reason).
+#: Present = the breaker tripped for the recorded target; it stops
+#: blocking as soon as the observed target hash moves off that revision
+#: (rollback landed, or a fixed revision was published).
+UPGRADE_BREAKER_ANNOTATION_KEY_FMT = (
+    DOMAIN + "/%s-upgrade.remediation-breaker"
+)
+
+#: Node annotation counting upgrade attempts that ended in
+#: upgrade-failed — the substrate of the per-node retry budget.
+#: Cleared when the node completes an upgrade (or self-heals).
+UPGRADE_ATTEMPT_COUNT_ANNOTATION_KEY_FMT = DOMAIN + "/%s-upgrade.attempt-count"
+
+#: Node annotation stamping when the current failure episode was first
+#: observed (unix seconds).  Present = episode open; drives the
+#: exponential retry backoff.  Cleared on self-heal and on retry.
+UPGRADE_LAST_FAILURE_AT_ANNOTATION_KEY_FMT = (
+    DOMAIN + "/%s-upgrade.last-failure-at"
+)
+
+#: Node annotation recording the DS target revision hash the failure
+#: episode happened against — the breaker census only charges failures
+#: to the CURRENT target, so a rolled-back revision's wreckage cannot
+#: re-trip the breaker against the fixed one.
+UPGRADE_FAILURE_TARGET_ANNOTATION_KEY_FMT = (
+    DOMAIN + "/%s-upgrade.failure-target"
+)
+
+#: Node taint applied when the retry budget quarantines a node
+#: (effect NoSchedule); removed when the quarantine is released.
+UPGRADE_QUARANTINE_TAINT_KEY_FMT = DOMAIN + "/%s-upgrade.quarantined"
+
+#: Value prefix marking a quarantine annotation as REMEDIATION-owned
+#: (retry budget exhausted) rather than health-owned; the
+#: SliceHealthManager only lifts health-owned quarantines.
+REMEDIATION_QUARANTINE_PREFIX = "remediation:"
+
 # ---- TPU-native additions -------------------------------------------------
 
 #: Node annotation used for the checkpoint-on-drain handshake.  The

@@ -73,7 +73,7 @@ class GateStatus:
     closed (when it reopens), pacing exhausted (when budget returns) —
     not just "pending"."""
 
-    #: "canary" | "maintenanceWindow" | "pacing"
+    #: "canary" | "maintenanceWindow" | "pacing" | "remediation"
     gate: str
     #: True when the gate currently blocks new admissions.
     blocking: bool
@@ -219,8 +219,13 @@ class RolloutStatus:
             out["gates"] = [g.to_dict() for g in self.gates]
         return out
 
-    def summary(self) -> str:
-        """One-line progress summary (the kubectl-rollout-status analog)."""
+    def summary(self, lead_gate: bool = True) -> str:
+        """One-line progress summary (the kubectl-rollout-status analog).
+        A blocked rollout LEADS with the first blocking gate — the thing
+        an operator staring at a frozen rollout needs first — instead of
+        burying it behind the counters.  ``lead_gate=False`` renders the
+        bare counters (for callers that already printed the gate, like
+        :meth:`render`)."""
         line = (
             f"done {self.done}/{self.total_nodes} nodes "
             f"({self.domains_done}/{self.total_domains} domains, "
@@ -230,14 +235,26 @@ class RolloutStatus:
             + (f" unknown {self.unknown}" if self.unknown else "")
         )
         blocking = self.blocking_gates
-        if blocking and self.pending:
-            line += " — GATED: " + "; ".join(g.reason for g in blocking)
+        if lead_gate and blocking and self.pending:
+            first = blocking[0]
+            line = f"GATED [{first.gate}]: {first.reason} — " + line
+            if len(blocking) > 1:
+                line += " — also gated: " + "; ".join(
+                    g.reason for g in blocking[1:]
+                )
         return line
 
     def render(self) -> str:
-        """Multi-line human table: the summary plus one row per domain."""
-        lines = [self.summary(), ""]
+        """Multi-line human table: the first blocking gate (if any)
+        leads, then the counters, the gate list, and one row per
+        domain."""
         blocking = self.blocking_gates
+        lines = []
+        if blocking:
+            lines.append(f"BLOCKED [{blocking[0].gate}]: {blocking[0].reason}")
+            lines.append("")
+        # counters only — the gate lead above already said WHY
+        lines.extend([self.summary(lead_gate=False), ""])
         if blocking:
             lines.append("admission gates:")
             for g in blocking:
@@ -371,6 +388,9 @@ def _evaluate_gates(state, policy) -> List[GateStatus]:
                 )
             )
 
+    if getattr(policy, "remediation", None) is not None:
+        gates.append(_remediation_gate(state))
+
     if policy.max_nodes_per_hour > 0:
         budget = schedule.pacing_budget(policy, all_nodes)
         if budget is not None and budget <= 0:
@@ -415,3 +435,59 @@ def _evaluate_gates(state, policy) -> List[GateStatus]:
                 )
             )
     return gates
+
+
+def _remediation_gate(state) -> GateStatus:
+    """The failure-budget breaker's gate, evaluated purely from the
+    DaemonSet/node annotations the live RemediationManager maintains —
+    so an offline ``status --state-file`` dump explains a paused fleet
+    exactly like the live scheduler sees it."""
+    from .remediation import remediation_report
+
+    report = remediation_report(state)
+    breaker = report.get("breaker")
+    quarantined = report.get("quarantinedNodes") or []
+    detail: Dict[str, object] = {
+        "lastKnownGood": report.get("lastKnownGood") or {},
+        "quarantinedNodes": quarantined,
+    }
+    if breaker is None:
+        reason = "remediation breaker closed"
+        if quarantined:
+            reason += f"; {len(quarantined)} node(s) quarantined"
+        return GateStatus(
+            gate="remediation", blocking=False, reason=reason, detail=detail
+        )
+    detail["breaker"] = breaker
+    if report.get("blocking"):
+        return GateStatus(
+            gate="remediation",
+            blocking=True,
+            reason=(
+                "remediation BREAKER OPEN: "
+                + str(breaker.get("reason", ""))
+                + "; admissions paused until the fleet rolls back or a "
+                "fixed revision is published"
+            ),
+            detail=detail,
+        )
+    state_word = str(breaker.get("state", ""))
+    if state_word == "rolled-back":
+        lkg = {
+            name: rec.get("lkg")
+            for name, rec in (report.get("lastKnownGood") or {}).items()
+        }
+        reason = (
+            "rolled back to last-known-good "
+            + (", ".join(sorted(str(v) for v in lkg.values())) or "revision")
+            + f" after breaker trip ({breaker.get('reason', '')})"
+        )
+    else:
+        reason = (
+            f"breaker tripped on abandoned revision "
+            f"{breaker.get('target', '?')} (not the current target); "
+            "admissions flowing"
+        )
+    return GateStatus(
+        gate="remediation", blocking=False, reason=reason, detail=detail
+    )

@@ -61,6 +61,40 @@ logger = logging.getLogger(__name__)
 PodKey = Tuple[str, str]
 
 
+def _remediation_annotations_only(
+    old: Optional[JsonObj], new: Optional[JsonObj]
+) -> bool:
+    """True when *old* → *new* differs only in the remediation
+    bookkeeping annotations (plus resourceVersion) — the one DaemonSet
+    write class that cannot affect the snapshot grouping or the
+    revision oracle, and therefore must not dirty the whole fleet."""
+    if old is None or new is None:
+        return False
+    bookkeeping = {
+        util.get_last_known_good_annotation_key(),
+        util.get_breaker_annotation_key(),
+    }
+
+    def normalized(ds: JsonObj) -> JsonObj:
+        out = dict(ds)
+        meta = dict(out.get("metadata") or {})
+        meta.pop("resourceVersion", None)
+        annotations = {
+            k: v
+            for k, v in (meta.get("annotations") or {}).items()
+            if k not in bookkeeping
+        }
+        meta["annotations"] = annotations
+        out["metadata"] = meta
+        return out
+
+    if (old.get("metadata") or {}).get("annotations") == (
+        new.get("metadata") or {}
+    ).get("annotations"):
+        return False  # annotations did not move: not this write class
+    return normalized(old) == normalized(new)
+
+
 class ClusterStateIndex:
     """Incrementally maintained cluster-state snapshot for one
     (namespace, driver-labels) scope.
@@ -365,12 +399,35 @@ class ClusterStateIndex:
         tracked = uid in self._daemon_sets
         if not in_scope and not tracked:
             return
+        current = self._daemon_sets.get(uid)
+        if (
+            ev.type == "Modified"
+            and in_scope
+            and tracked
+            and not self._stale(current, ev.seq)
+            and _remediation_annotations_only(current, obj)
+        ):
+            # Remediation bookkeeping (the LKG/breaker annotations the
+            # RemediationManager re-writes as rollouts progress) cannot
+            # move the revision oracle or the ownership grouping — a
+            # fleet-wide dirty per bookkeeping write would make every
+            # remediation-enabled reconcile O(fleet) and defeat the
+            # incremental build.  Absorb it in place, views included
+            # (handed-out snapshots share the view DS dict).
+            self._daemon_sets[uid] = obj
+            view = self._view_ds.get(uid)
+            if view is not None:
+                view_meta = view.setdefault("metadata", {})
+                view_meta["annotations"] = dict(meta.get("annotations") or {})
+                view_meta["resourceVersion"] = meta.get("resourceVersion")
+            self.events_applied += 1
+            return
         # A driver DaemonSet changed (template edit, desired count,
         # scope entry/exit): ownership grouping and the revision oracle
         # are both suspect — everything is dirty.
         self._all_dirty = True
         self._order = None
-        if self._stale(self._daemon_sets.get(uid), ev.seq):
+        if self._stale(current, ev.seq):
             return
         if ev.type == "Deleted" or not in_scope:
             self._daemon_sets.pop(uid, None)

@@ -218,7 +218,10 @@ class InplaceNodeStateManager:
 
     # ---------------------------------------------------- upgrade-required
     def process_upgrade_required_nodes(
-        self, state: ClusterUpgradeState, policy: UpgradePolicySpec
+        self,
+        state: ClusterUpgradeState,
+        policy: UpgradePolicySpec,
+        remediation=None,
     ) -> None:
         common = self._common
         slice_aware = policy.slice_aware
@@ -265,8 +268,28 @@ class InplaceNodeStateManager:
         if policy.canary_domains > 0:
             canary = self._canary_budget(state, policy)
 
-        node_states = state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        # Remediation gate: a tripped breaker pauses FRESH version
+        # exposure (bypass admissions included — a cordoned node still
+        # runs the bad revision); stragglers of already-active domains
+        # keep flowing (their slice is already disrupted, stranding it
+        # half-upgraded is worse).  The retry-budget quarantine routes
+        # the wave around chronically failing domains regardless of
+        # policy.quarantine_degraded.
+        remediation_blocked = remediation is not None and remediation.paused
+        if remediation_blocked and state.nodes_in(
+            consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        ):
+            logger.info(
+                "remediation breaker open; fresh admissions paused (%s)",
+                remediation.reason,
+            )
         quarantined = self._quarantined_domains(state, policy)
+        if remediation is not None and remediation.quarantined_domains:
+            quarantined = (quarantined or set()) | set(
+                remediation.quarantined_domains
+            )
+
+        node_states = state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED)
         if slice_aware:
             self._schedule_by_domain(
                 state,
@@ -276,10 +299,16 @@ class InplaceNodeStateManager:
                 pacing,
                 pacing_limit=policy.max_nodes_per_hour,
                 canary=canary,
+                remediation_blocked=remediation_blocked,
             )
         else:
             self._schedule_by_node(
-                node_states, available, quarantined, pacing, canary=canary
+                node_states,
+                available,
+                quarantined,
+                pacing,
+                canary=canary,
+                remediation_blocked=remediation_blocked,
             )
 
     def _canary_budget(
@@ -342,8 +371,14 @@ class InplaceNodeStateManager:
         quarantined=None,
         pacing=None,
         canary: Optional[int] = None,
+        remediation_blocked: bool = False,
     ) -> None:
         common = self._common
+        if remediation_blocked:
+            # Node-granular mode has no domain-straggler notion: every
+            # admission is fresh version exposure, so a tripped breaker
+            # blocks them all.
+            return
         for node_state in node_states:
             if not self._prepare(node_state):
                 continue
@@ -393,6 +428,7 @@ class InplaceNodeStateManager:
         pacing=None,
         pacing_limit: int = 0,
         canary: Optional[int] = None,
+        remediation_blocked: bool = False,
     ) -> None:
         """Slice-aware scheduling: one slot = one domain; all of a chosen
         domain's upgrade-required nodes advance together.
@@ -432,6 +468,10 @@ class InplaceNodeStateManager:
                     "domain %s is quarantined (degraded host), not admitting",
                     domain,
                 )
+                continue
+            # Tripped breaker: no FRESH version exposure; active-domain
+            # stragglers still finish (same principle as quarantine).
+            if remediation_blocked and fresh:
                 continue
             if not bypass:
                 if available <= 0:

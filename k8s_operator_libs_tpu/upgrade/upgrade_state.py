@@ -44,6 +44,7 @@ from .cordon_manager import CordonManager
 from .drain_manager import DrainManager, PreDrainGate
 from .node_upgrade_state_provider import NodeUpgradeStateProvider
 from .pod_manager import PodDeletionFilter, PodManager
+from .remediation import RemediationDecision, RemediationManager
 from .safe_driver_load_manager import SafeDriverLoadManager
 from .state_index import ClusterStateIndex
 from .upgrade_inplace import InplaceNodeStateManager
@@ -179,6 +180,13 @@ class ClusterUpgradeStateManager:
         #: pre-built/externally-fed *state_index*.
         self._use_state_index = use_state_index or state_index is not None
         self._state_index = state_index
+        #: Remediation engine (upgrade/remediation.py): LKG rollback,
+        #: failure-budget breaker, per-node retry budgets.  Constructed
+        #: eagerly (cheap) but entirely inert until a policy carries a
+        #: ``remediation`` block.
+        self._remediation = RemediationManager(
+            cluster, self._provider, recorder
+        )
 
     def shutdown(self, wait: bool = True) -> None:
         """Release the worker-pool threads this manager owns.  Long-lived
@@ -278,6 +286,16 @@ class ClusterUpgradeStateManager:
     def get_requestor(self):
         """Reference: GetRequestor (upgrade_state.go:283-285)."""
         return self._requestor
+
+    @property
+    def remediation(self) -> RemediationManager:
+        return self._remediation
+
+    def remediation_status(self) -> Optional[dict]:
+        """The most recent remediation decision as a JSON-able dict —
+        the ``OpsServer GET /debug/remediation`` payload.  None before
+        the first reconcile under a remediation-enabled policy."""
+        return self._remediation.last_status()
 
     # ------------------------------------------------------------ BuildState
     @property
@@ -477,6 +495,11 @@ class ClusterUpgradeStateManager:
         self.last_apply_transitions = 0
         if state is None:
             raise UpgradeStateError("currentState should not be empty")
+        if policy is None or policy.remediation is None:
+            # Engine off (block removed / CR deleted): retire the stale
+            # decision so gauges and /debug/remediation don't keep
+            # reporting the last breaker position forever.
+            self._remediation.disable()
         if policy is not None:
             self._configure_from_policy(policy)
         else:
@@ -652,6 +675,15 @@ class ClusterUpgradeStateManager:
                 ),
             )
 
+        # Remediation engine (breaker/LKG/rollback census + bookkeeping):
+        # runs before the phases so the admission phase sees this pass's
+        # verdict; its retry processor rides the phase list below.  None
+        # when the policy carries no remediation block — every downstream
+        # consumer treats that as "engine off" (reference behavior).
+        remediation: Optional[RemediationDecision] = None
+        if policy.remediation is not None:
+            remediation = self._remediation.evaluate(state, policy, common)
+
         # All phases run under one deferred-visibility barrier: node writes
         # land immediately, and their informer-cache visibility is awaited
         # once at the end — the next reconcile still never reads stale
@@ -666,8 +698,20 @@ class ClusterUpgradeStateManager:
             lambda: common.process_done_or_unknown_nodes(
                 state, consts.UPGRADE_STATE_DONE
             ),
+            # 2b. remediation recovery: release repaired nodes' retry
+            #     bookkeeping/quarantine (runs even with the engine off —
+            #     leftover quarantines must not outlive a removed block)
+            #     and un-admit pending nodes a rollback overtook (pod
+            #     already in sync — a wave pass would drain real
+            #     workloads for a no-op); BEFORE admission so the
+            #     scheduler never charges slots for them
+            lambda: self._remediation.process_recovered_nodes(
+                state, policy, common
+            ),
             # 3. start upgrades up to the throttle (mode dispatch)
-            lambda: self._process_upgrade_required_nodes_wrapper(state, policy),
+            lambda: self._process_upgrade_required_nodes_wrapper(
+                state, policy, remediation
+            ),
             # 4. cordon
             lambda: common.process_cordon_required_nodes(state),
             # 5. wait for jobs
@@ -687,8 +731,14 @@ class ClusterUpgradeStateManager:
             lambda: self._process_post_maintenance_required_nodes_wrapper(state),
             # 9. pod restart (+ failure detection)
             lambda: common.process_pod_restart_nodes(state),
-            # 10. failed-node self-healing, then validation
+            # 10. failed-node self-healing, then the remediation retry
+            #     budget (backoff'd failed->upgrade-required retries,
+            #     quarantine on exhaustion; no-op without a remediation
+            #     policy), then validation
             lambda: common.process_upgrade_failed_nodes(state),
+            lambda: self._remediation.process_failed_nodes(
+                state, policy, common
+            ),
             lambda: common.process_validation_required_nodes(state),
             # 11. uncordon (both modes' processors run — reference :311-325)
             lambda: self._process_uncordon_required_nodes_wrapper(state),
@@ -796,13 +846,27 @@ class ClusterUpgradeStateManager:
 
     # ---------------------------------------------------- mode dispatchers
     def _process_upgrade_required_nodes_wrapper(
-        self, state: ClusterUpgradeState, policy: UpgradePolicySpec
+        self,
+        state: ClusterUpgradeState,
+        policy: UpgradePolicySpec,
+        remediation: Optional[RemediationDecision] = None,
     ) -> None:
         """Reference: ProcessUpgradeRequiredNodesWrapper (:287-297)."""
         if self._use_maintenance_operator and self._requestor is not None:
+            if remediation is not None and remediation.paused:
+                # Breaker open: no new NodeMaintenance handoffs — the bad
+                # revision must not spread through the external operator
+                # either.  Mid-maintenance nodes finish via the other
+                # requestor processors, which keep running.
+                logger.info(
+                    "remediation breaker open; no new requestor handoffs"
+                )
+                return
             self._requestor.process_upgrade_required_nodes(state, policy)
         else:
-            self.inplace.process_upgrade_required_nodes(state, policy)
+            self.inplace.process_upgrade_required_nodes(
+                state, policy, remediation=remediation
+            )
 
     def _process_node_maintenance_required_nodes_wrapper(
         self, state: ClusterUpgradeState

@@ -203,6 +203,44 @@ def get_admitted_bypass_annotation_key() -> str:
     )
 
 
+def get_last_known_good_annotation_key() -> str:
+    """Remediation: DaemonSet LKG-revision record annotation key."""
+    return (
+        consts.UPGRADE_LAST_KNOWN_GOOD_ANNOTATION_KEY_FMT % get_component_name()
+    )
+
+
+def get_breaker_annotation_key() -> str:
+    """Remediation: DaemonSet failure-budget breaker record key."""
+    return consts.UPGRADE_BREAKER_ANNOTATION_KEY_FMT % get_component_name()
+
+
+def get_attempt_count_annotation_key() -> str:
+    """Remediation: per-node upgrade-attempt counter key."""
+    return (
+        consts.UPGRADE_ATTEMPT_COUNT_ANNOTATION_KEY_FMT % get_component_name()
+    )
+
+
+def get_last_failure_at_annotation_key() -> str:
+    """Remediation: open-failure-episode timestamp key."""
+    return (
+        consts.UPGRADE_LAST_FAILURE_AT_ANNOTATION_KEY_FMT % get_component_name()
+    )
+
+
+def get_failure_target_annotation_key() -> str:
+    """Remediation: revision hash the failure episode was attempted on."""
+    return (
+        consts.UPGRADE_FAILURE_TARGET_ANNOTATION_KEY_FMT % get_component_name()
+    )
+
+
+def get_quarantine_taint_key() -> str:
+    """Remediation: NoSchedule taint key for quarantined nodes."""
+    return consts.UPGRADE_QUARANTINE_TAINT_KEY_FMT % get_component_name()
+
+
 def get_event_reason() -> str:
     """Reference: GetEventReason (util.go:157-160)."""
     return "%sUpgrade" % get_component_name()

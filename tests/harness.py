@@ -47,6 +47,11 @@ class Fleet:
             make_controller_revision(self.ds, self.revision, revision_hash)
         )
         self._pod_seq = itertools.count()
+        #: Revision hashes whose pods come up BROKEN (driver container
+        #: not ready, restartCount past the >10 failure threshold) —
+        #: the bad-release injection the remediation suite drives
+        #: breaker trips with.
+        self.bad_revisions: set = set()
         #: node names this DaemonSet schedules onto (add_node only); nodes
         #: created directly on the cluster (e.g. orphan-pod hosts) are not
         #: the DS's responsibility, matching real DS node targeting.
@@ -183,9 +188,36 @@ class Fleet:
                 self._ds_cursor = cursor
         return {n for n, pods in self._covered_pods.items() if pods}
 
+    def _refresh_revision(self) -> None:
+        """Follow the newest ControllerRevision, like the real DaemonSet
+        controller — this is what makes a remediation LKG rollback (which
+        promotes the old ControllerRevision to newest, the
+        ``kubectl rollout undo`` mechanism) actually change what gets
+        recreated.  ``publish_new_revision`` keeps working unchanged: it
+        creates the newest revision, so the refresh agrees with it."""
+        revisions = [
+            cr
+            for cr in self.cluster.list(
+                "ControllerRevision", namespace=NAMESPACE
+            )
+            if (cr.get("metadata") or {}).get("name", "").startswith(
+                "tpu-runtime-"
+            )
+        ]
+        if not revisions:
+            return
+        newest = max(revisions, key=lambda cr: cr.get("revision", 0))
+        self.revision = newest.get("revision", self.revision)
+        self.revision_hash = (
+            (newest.get("metadata") or {}).get("labels") or {}
+        ).get("controller-revision-hash", self.revision_hash)
+
     def reconcile_daemonset(self) -> int:
-        """Recreate missing driver pods at the current revision; returns the
-        number of pods created."""
+        """Recreate missing driver pods at the current (newest
+        ControllerRevision) revision; returns the number of pods
+        created.  Pods of a revision listed in :attr:`bad_revisions`
+        come up failing (not ready, restartCount 11)."""
+        self._refresh_revision()
         covered = self._covered_nodes()
         created = 0
         for name in sorted(self.managed_nodes - covered):
@@ -197,6 +229,7 @@ class Fleet:
                 self.cluster.get("Node", name)
             except NotFoundError:
                 continue
+            bad = self.revision_hash in self.bad_revisions
             pod = make_pod(
                 f"tpu-runtime-{next(self._pod_seq)}",
                 NAMESPACE,
@@ -204,7 +237,8 @@ class Fleet:
                 labels=dict(DRIVER_LABELS),
                 owner=self.ds,
                 revision_hash=self.revision_hash,
-                ready=True,
+                ready=not bad,
+                restart_count=11 if bad else 0,
             )
             self.cluster.create(pod)
             if self._covered_pods is not None:

@@ -431,6 +431,14 @@ LEGAL_TRANSITIONS = frozenset(
         (_C.UPGRADE_STATE_VALIDATION_REQUIRED, _C.UPGRADE_STATE_FAILED),
         (_C.UPGRADE_STATE_FAILED, _C.UPGRADE_STATE_UNCORDON_REQUIRED),
         (_C.UPGRADE_STATE_FAILED, _C.UPGRADE_STATE_DONE),
+        # remediation retry budget (upgrade/remediation.py): a failed
+        # node whose pod is out of sync with the target (new revision or
+        # LKG rollback waiting) re-enters the wave after its backoff
+        (_C.UPGRADE_STATE_FAILED, _C.UPGRADE_STATE_UPGRADE_REQUIRED),
+        # remediation rollback overtaking admission: a pending node whose
+        # pod is back in sync after the LKG revert returns to done
+        # without riding the wave (no cordon/drain for a no-op)
+        (_C.UPGRADE_STATE_UPGRADE_REQUIRED, _C.UPGRADE_STATE_DONE),
         (_C.UPGRADE_STATE_UNCORDON_REQUIRED, _C.UPGRADE_STATE_DONE),
     }
 )
@@ -950,6 +958,288 @@ class TestPolicyMutationChaos:
             if set(fleet.states().values()) == {consts.UPGRADE_STATE_DONE}:
                 return
         pytest.fail(f"seed {seed}: did not converge after resume")
+
+
+# ---------------------------------------------------------------------------
+# Remediation convergence: random fleets with an injected bad revision and
+# autoRollback enabled always converge back to the last-known-good revision
+# riding only legal state-machine edges — including crash-resume
+# mid-rollback (the operator dying between the breaker trip, the
+# ControllerRevision promotion, and the retry transitions).
+# ---------------------------------------------------------------------------
+
+
+class TestRemediationConvergence:
+    def _remediation_policy(self, rng: random.Random) -> UpgradePolicySpec:
+        from k8s_operator_libs_tpu.api import RemediationSpec
+
+        return UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=rng.choice([0, 1, 2]),
+            max_unavailable=IntOrString(rng.choice([1, 2, "50%"])),
+            slice_aware=rng.choice([True, False]),
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+            remediation=RemediationSpec(
+                failure_threshold=0.5,
+                min_attempted=1,
+                auto_rollback=True,
+                max_node_attempts=6,
+                backoff_seconds=0.0,
+            ),
+        )
+
+    def _drive_to_lkg(
+        self,
+        cluster,
+        inner,
+        fleet,
+        policy,
+        rng,
+        crashing=None,
+        cycles=160,
+        check_budgets=True,
+    ) -> None:
+        state_key = util.get_upgrade_state_label_key()
+        manager = make_manager(cluster)
+        # healthy era first: the LKG tracker must observe rev1 as the
+        # standing target before the bad revision lands
+        for _ in range(3):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10.0)
+            manager.pod_manager.wait_idle(10.0)
+            fleet.reconcile_daemonset()
+        fleet.bad_revisions.add("rev2")
+        fleet.publish_new_revision("rev2")
+        for _ in range(cycles):
+            try:
+                if crashing is not None and rng.random() < 0.4:
+                    crashing.arm(rng.randint(0, 6))
+                state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+                manager.apply_state(state, policy)
+            except SimulatedCrash:
+                pass
+            finally:
+                if crashing is not None:
+                    crashing.disarm()
+            manager.drain_manager.wait_idle(10.0)
+            manager.pod_manager.wait_idle(10.0)
+            if crashing is not None:
+                # replacement operator process: fresh manager + cache
+                manager = make_manager(cluster)
+            fleet.reconcile_daemonset()
+            if check_budgets:
+                # the rollback wave obeys maxUnavailable/slice budgets
+                # like any other wave (acceptance criterion)
+                check_invariants(inner, policy)
+            nodes = inner.list("Node")
+            if nodes and all(
+                (n["metadata"].get("labels") or {}).get(state_key)
+                == consts.UPGRADE_STATE_DONE
+                for n in nodes
+            ) and all(
+                p["metadata"]["labels"]["controller-revision-hash"] == "rev1"
+                for p in inner.list("Pod", namespace=NAMESPACE)
+            ):
+                return
+        pytest.fail(f"fleet did not converge to LKG: {fleet.states()}")
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_bad_revision_rolls_back_to_lkg(self, seed):
+        rng = random.Random(11_000 + seed)
+        cluster = InMemoryCluster()
+        fleet = build_random_fleet(rng, cluster)
+        # build_random_fleet already published rev2; rebuild a clean
+        # rev1-era fleet instead: pods start in sync at rev1
+        cluster = InMemoryCluster()
+        fleet = Fleet(cluster)
+        for s in range(rng.randint(2, 3)):
+            for h in range(rng.randint(2, 3)):
+                fleet.add_node(f"s{s}-h{h}", labels={SLICE_KEY: f"slice-{s}"})
+        policy = self._remediation_policy(rng)
+        self._drive_to_lkg(cluster, cluster, fleet, policy, rng)
+        # the breaker demonstrably tripped and rolled back
+        ds = cluster.get("DaemonSet", "tpu-runtime", NAMESPACE)
+        breaker_raw = (ds["metadata"].get("annotations") or {}).get(
+            util.get_breaker_annotation_key()
+        )
+        lkg_raw = (ds["metadata"].get("annotations") or {}).get(
+            util.get_last_known_good_annotation_key()
+        )
+        import json as _json
+
+        assert lkg_raw and _json.loads(lkg_raw)["target"] == "rev1"
+        if breaker_raw:  # may have retired once the wreckage cleaned
+            assert _json.loads(breaker_raw)["state"] == "rolled-back"
+        # every edge legal, including the remediation retry edge
+        illegal = [
+            t
+            for t in observed_transitions(cluster)
+            if t not in LEGAL_TRANSITIONS
+        ]
+        assert illegal == [], f"seed {seed}: illegal transitions {illegal}"
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_rollback_survives_operator_crashes(self, seed):
+        """Crash-resume mid-rollback: the operator dies at random write
+        budgets (possibly between the trip, the ControllerRevision
+        promotion, and the retry transitions); replacements must resume
+        from the annotation-resident remediation state and still land
+        the whole fleet back on the LKG revision."""
+        rng = random.Random(12_000 + seed)
+        inner = InMemoryCluster()
+        cluster = CrashingCluster(inner)
+        fleet = Fleet(cluster)
+        for s in range(rng.randint(2, 3)):
+            for h in range(rng.randint(2, 3)):
+                fleet.add_node(f"s{s}-h{h}", labels={SLICE_KEY: f"slice-{s}"})
+        policy = self._remediation_policy(rng)
+        self._drive_to_lkg(cluster, inner, fleet, policy, rng, crashing=cluster)
+        illegal = [
+            t
+            for t in observed_transitions(inner)
+            if t not in LEGAL_TRANSITIONS
+        ]
+        assert illegal == [], f"seed {seed}: illegal transitions {illegal}"
+
+    def test_bad_revision_at_512_nodes_trips_and_rolls_back(self):
+        """The acceptance scenario: an injected bad revision on a
+        512-node slice-aware fleet trips the breaker and returns every
+        upgraded node to the LKG revision without violating the
+        maxUnavailable slice budget."""
+        from k8s_operator_libs_tpu.api import RemediationSpec
+
+        rng = random.Random(13_000)
+        cluster = InMemoryCluster()
+        fleet = Fleet(cluster)
+        for s in range(128):
+            for h in range(4):
+                fleet.add_node(
+                    f"s{s:03d}-h{h}", labels={SLICE_KEY: f"sl-{s:03d}"}
+                )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("25%"),
+            slice_aware=True,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+            remediation=RemediationSpec(
+                failure_threshold=0.25,
+                min_attempted=8,
+                auto_rollback=True,
+                max_node_attempts=10,
+                backoff_seconds=0.0,
+            ),
+        )
+        self._drive_to_lkg(cluster, cluster, fleet, policy, rng, cycles=400)
+        from k8s_operator_libs_tpu import metrics
+
+        assert metrics.default_registry().counter(
+            "remediation_breaker_trips_total",
+            "Failure-budget circuit breaker trips.",
+        ).value() >= 1
+        assert metrics.default_registry().counter(
+            "rollbacks_total",
+            "Automatic last-known-good DaemonSet rollbacks initiated.",
+        ).value() >= 1
+        illegal = [
+            t
+            for t in observed_transitions(cluster)
+            if t not in LEGAL_TRANSITIONS
+        ]
+        assert illegal == [], f"illegal transitions {illegal}"
+
+    def test_quarantine_routes_wave_around_chronic_failure(self):
+        """A node that fails on EVERY revision exhausts its retry budget,
+        is quarantined (annotation + NoSchedule taint), and the rest of
+        the fleet still converges to the LKG — the wave routes around
+        the chronic failure instead of retrying forever."""
+        from k8s_operator_libs_tpu.api import RemediationSpec
+
+        cluster = InMemoryCluster()
+        fleet = Fleet(cluster)
+        for h in range(2):
+            fleet.add_node(f"s0-h{h}", labels={SLICE_KEY: "s0"})
+            fleet.add_node(f"s1-h{h}", labels={SLICE_KEY: "s1"})
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            # 100%: a quarantined node still holds unavailability budget
+            # (its capacity is genuinely down — docs/automatic-upgrade.md);
+            # a tighter budget would wedge on the chronic node by design
+            max_unavailable=IntOrString("100%"),
+            slice_aware=True,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+            remediation=RemediationSpec(
+                failure_threshold=0.9,  # high: the breaker must NOT trip
+                min_attempted=50,
+                auto_rollback=False,
+                max_node_attempts=2,
+                backoff_seconds=0.0,
+            ),
+        )
+        manager = make_manager(cluster)
+        for _ in range(3):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10.0)
+            manager.pod_manager.wait_idle(10.0)
+            fleet.reconcile_daemonset()
+
+        # rev2 is healthy fleet-wide, but s0-h0's replacement pods are
+        # broken by hand every cycle — a chronic single-node failure
+        fleet.publish_new_revision("rev2")
+        quarantine_key = util.get_quarantine_annotation_key()
+
+        def break_node_pod() -> None:
+            for pod in cluster.list("Pod", namespace=NAMESPACE):
+                if (
+                    pod["spec"].get("nodeName") == "s0-h0"
+                    and pod["metadata"]["labels"][
+                        "controller-revision-hash"
+                    ]
+                    == "rev2"
+                ):
+                    pod["status"]["containerStatuses"] = [
+                        {"name": "driver", "ready": False, "restartCount": 11}
+                    ]
+                    cluster.update(pod)
+
+        state_key = util.get_upgrade_state_label_key()
+        for _ in range(100):
+            break_node_pod()
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            manager.drain_manager.wait_idle(10.0)
+            manager.pod_manager.wait_idle(10.0)
+            fleet.reconcile_daemonset()
+            node = cluster.get("Node", "s0-h0")
+            quarantined = (
+                (node["metadata"].get("annotations") or {})
+                .get(quarantine_key, "")
+                .startswith(consts.REMEDIATION_QUARANTINE_PREFIX)
+            )
+            others_done = all(
+                (n["metadata"].get("labels") or {}).get(state_key)
+                == consts.UPGRADE_STATE_DONE
+                for n in cluster.list("Node")
+                if n["metadata"]["name"] not in ("s0-h0", "s0-h1")
+            )
+            if quarantined and others_done:
+                break
+        else:
+            pytest.fail(
+                f"quarantine/convergence not reached: {fleet.states()}"
+            )
+        node = cluster.get("Node", "s0-h0")
+        taints = (node.get("spec") or {}).get("taints") or []
+        assert any(
+            t.get("key") == util.get_quarantine_taint_key() for t in taints
+        ), f"quarantine taint missing: {taints}"
+        attempts = (node["metadata"].get("annotations") or {}).get(
+            util.get_attempt_count_annotation_key()
+        )
+        assert attempts is not None and int(attempts) >= 2
 
 
 class TestPaginatedPathChaos:
