@@ -11,7 +11,8 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 
 .PHONY: all test test-fast lint bench bench-scale smoke graft-check cov \
 	cov-report clean help image .build-image kind-e2e kind-e2e-stub \
-	tpu-smoke tpu-probe tpu-watch tpu-stage verify-obs verify-remediation
+	tpu-smoke tpu-probe tpu-watch tpu-stage verify-obs verify-remediation \
+	verify-slo
 
 # Enforced coverage floor (VERDICT r4 next #6).  Full-suite line
 # coverage measured by the zero-dependency sys.monitoring tracer
@@ -52,6 +53,13 @@ verify-remediation:
 	$(PYTHON) -m pytest tests/test_remediation.py \
 		"tests/test_resilience.py::TestRemediationConvergence" -q
 	$(PYTHON) -m k8s_operator_libs_tpu remediation --selftest
+
+# SLO gate: the flight-recorder/analytics/SLO suite plus the in-process
+# end-to-end smoke (harness fleet → timelines → ETA/stragglers →
+# declared breach exposed via /debug/slo, rollout_status and /metrics).
+verify-slo:
+	$(PYTHON) -m pytest tests/test_slo.py -q
+	$(PYTHON) -m k8s_operator_libs_tpu slo --selftest
 
 lint:
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu examples bench.py __graft_entry__.py

@@ -173,6 +173,11 @@ def run_real(args) -> int:
             # paused or rolling-back fleet (decision is null until the
             # first remediation-enabled reconcile publishes one)
             remediation_source=manager.remediation_status,
+            # rollout ETA / stragglers / SLO breaches + per-node phase
+            # timelines (report is null until the first reconcile under
+            # a policy declaring an slos block)
+            slo_source=manager.slo_status,
+            timeline_source=manager.timeline_status,
         ).start()
         ops.add_health_check("controller", runnable.running)
         # A hot HA standby is READY (it serves its purpose: being able
@@ -180,7 +185,8 @@ def run_real(args) -> int:
         ops.add_ready_check("replica", runnable.running)
         print(
             f"ops endpoints on {ops.url} "
-            "(/metrics /healthz /readyz /debug/traces /debug/remediation)"
+            "(/metrics /healthz /readyz /debug/traces /debug/remediation "
+            "/debug/slo /debug/timeline)"
         )
     started = False
     try:

@@ -186,9 +186,16 @@ def cmd_status(args: argparse.Namespace) -> int:
         return rc
     util.set_component_name(args.component)
     from .cluster.errors import ApiError
+    from .obs import slo as slo_mod
+    from .upgrade import timeline as timeline_mod
     from .upgrade.upgrade_state import UpgradeStateError
 
-    manager = ClusterUpgradeStateManager(cluster)
+    # Timelines reconstructed from the node-annotation checkpoints feed
+    # the ETA / straggler / SLO fragments beside the gates (empty dumps
+    # simply render no SLO block).
+    recorder = timeline_mod.FlightRecorder()
+    slo_engine = slo_mod.SloEngine(recorder)
+    manager = ClusterUpgradeStateManager(cluster, flight_recorder=recorder)
     policy = None
     gates_noted = False
     last_policy_msg = None
@@ -234,13 +241,37 @@ def cmd_status(args: argparse.Namespace) -> int:
             gates_noted = True
         if policy is not None:
             _push_topology_keys(policy)
-        status = RolloutStatus.from_cluster_state(state, policy=policy)
-        rendered = (
-            json.dumps(status.to_dict()) if args.json else status.render()
+        status = RolloutStatus.from_cluster_state(
+            state,
+            policy=policy,
+            slo_report=slo_engine.evaluate(state, policy),
         )
-        if rendered != last_rendered:
+        payload = status.to_dict()
+        rendered = json.dumps(payload) if args.json else status.render()
+        # --watch dedupes on everything except the slo section's
+        # VOLATILE numbers: the ETA point estimate and generatedAt move
+        # on every evaluation and would print a full status every poll.
+        # Breach membership and the straggler set ARE part of the key —
+        # a newly wedged node must print immediately, not wait for an
+        # unrelated bucket change.
+        slo = payload.get("slo") or {}
+        change_key = json.dumps(
+            {
+                **{k: v for k, v in payload.items() if k != "slo"},
+                "sloBreaches": sorted(
+                    b.get("slo", "")
+                    for b in (slo.get("slos") or {}).get("breaches") or []
+                ),
+                "stragglers": sorted(
+                    s.get("node", "")
+                    for s in slo.get("stragglers") or []
+                ),
+            },
+            sort_keys=True,
+        )
+        if change_key != last_rendered:
             print(rendered, flush=True)
-            last_rendered = rendered
+            last_rendered = change_key
         if not args.watch:
             # kubectl-rollout-status convention: nonzero while not
             # complete lets scripts poll until the rollout finishes
@@ -468,6 +499,64 @@ def cmd_remediation(args: argparse.Namespace) -> int:
         print(render_report(report))
     # poll-friendly: nonzero while the breaker blocks admissions
     return 3 if (report.get("blocking") and args.wait_exit_code) else 0
+
+
+def cmd_slo(args: argparse.Namespace) -> int:
+    """Rollout SLO report: per-phase latency quantiles, fleet ETA with
+    confidence band, stragglers, and — when the policy declares an
+    ``slos`` block — breach/burn-rate evaluation.  Timelines are
+    reconstructed from the flight recorder's node-annotation
+    checkpoints, so the offline (``--state-file``) report matches what
+    the live operator's ``/debug/slo`` serves.  ``--selftest`` runs the
+    end-to-end smoke (the ``make verify-slo`` gate)."""
+    if args.selftest:
+        from .obs import slo as slo_mod
+
+        try:
+            print(slo_mod.selftest())
+        except AssertionError as err:
+            print(f"slo selftest FAILED: {err}", file=sys.stderr)
+            return 1
+        return 0
+    cluster, rc = _open_source(args, "slo")
+    if cluster is None:
+        return rc
+    util.set_component_name(args.component)
+    from .cluster.errors import ApiError
+    from .obs import slo as slo_mod
+    from .upgrade import timeline as timeline_mod
+    from .upgrade.upgrade_state import UpgradeStateError
+
+    policy, prc, pmsg = _load_policy_cr(args, cluster)
+    if pmsg:
+        print(pmsg, file=sys.stderr)
+    if prc:
+        return prc
+    if policy is not None:
+        _push_topology_keys(policy)
+    # A private recorder: build_state's observation sweep reloads every
+    # node's annotation checkpoint into it, which IS the offline
+    # reconstruction (the same code path the failed-over leader runs).
+    recorder = timeline_mod.FlightRecorder()
+    manager = ClusterUpgradeStateManager(cluster, flight_recorder=recorder)
+    try:
+        state = manager.build_state(
+            args.namespace, _parse_selector_arg(args.selector)
+        )
+    except (ApiError, OSError, UpgradeStateError) as err:
+        print(f"cannot read cluster state: {err}", file=sys.stderr)
+        return 2
+    finally:
+        manager.shutdown()
+    engine = slo_mod.SloEngine(recorder)
+    report = engine.evaluate(state, policy)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(slo_mod.render_report(report))
+    breaches = (report.get("slos") or {}).get("breaches") or []
+    # poll-friendly: nonzero while a declared SLO is in breach
+    return 3 if (breaches and args.wait_exit_code) else 0
 
 
 def cmd_repair(args: argparse.Namespace) -> int:
@@ -782,6 +871,36 @@ def main(argv=None) -> int:
         "exit 0/1 — the make verify-remediation gate (no source needed)",
     )
     rm.set_defaults(func=cmd_remediation)
+
+    sl = sub.add_parser(
+        "slo",
+        help="rollout SLO report: per-phase p50/p95/p99, fleet ETA with "
+        "confidence band, stragglers, and declared-SLO breach/burn "
+        "evaluation (timelines reconstructed from the flight recorder's "
+        "node-annotation checkpoints); --selftest smokes the pipeline "
+        "end-to-end",
+    )
+    _add_source_args(sl)
+    _add_query_args(sl)
+    sl.add_argument(
+        "--policy",
+        default="",
+        help="TpuUpgradePolicy name in the source; when it declares an "
+        "slos block, breaches and burn rates are evaluated (analytics "
+        "render either way)",
+    )
+    sl.add_argument(
+        "--wait-exit-code",
+        action="store_true",
+        help="exit 3 while a declared SLO is in breach (poll-friendly)",
+    )
+    sl.add_argument(
+        "--selftest",
+        action="store_true",
+        help="run the flight-recorder→analytics→breach smoke end-to-end "
+        "and exit 0/1 — the make verify-slo gate (no source needed)",
+    )
+    sl.set_defaults(func=cmd_slo)
 
     rp = sub.add_parser(
         "repair",

@@ -7,6 +7,7 @@ from .upgrade_spec import (
     PodDeletionSpec,
     PreDrainCheckpointSpec,
     RemediationSpec,
+    SloSpec,
     UpgradePolicySpec,
     ValidationError,
     ValidationSpec,
@@ -20,6 +21,7 @@ __all__ = [
     "PodDeletionSpec",
     "PreDrainCheckpointSpec",
     "RemediationSpec",
+    "SloSpec",
     "UpgradePolicySpec",
     "ValidationError",
     "ValidationSpec",

@@ -410,6 +410,76 @@ class RemediationSpec:
 
 
 @dataclass
+class SloSpec:
+    """Rollout service-level objectives, evaluated each reconcile by the
+    SLO engine (:mod:`..obs.slo`) over the flight recorder's per-node
+    phase timelines (:mod:`..upgrade.timeline`).  **Report-only**: a
+    breached SLO raises breach/burn-rate gauges and annotates
+    ``rollout_status`` — it never gates admissions (the canary / window
+    / pacing / remediation gates own enforcement).
+
+    Every target is seconds; 0 leaves that objective undeclared.
+    """
+
+    #: Ceiling for ANY single node's time in ANY one ACTIVE phase
+    #: (cordon, drain, pod-restart, ...).  The coarse "no node may
+    #: wedge" objective.  The admission queue (``upgrade-required``) is
+    #: exempt — a paced rollout legitimately queues its tail for hours,
+    #: and that is pacing, not node latency.  0 = unset.
+    max_node_phase_seconds: float = 0.0
+    #: Fleet-wide p99 target for the drain phase specifically — drains
+    #: are where workload disruption lives.  0 = unset.
+    drain_p99_seconds: float = 0.0
+    #: Whole-rollout wall-clock budget, measured from the first
+    #: admission of the rollout; breached when elapsed (or elapsed +
+    #: projected ETA) exceeds it.  0 = unset.
+    fleet_completion_deadline_seconds: float = 0.0
+    #: Straggler multiplier: a node sitting in a phase longer than
+    #: ``stragglerFactor`` × that phase's observed p95 is flagged.
+    straggler_factor: float = 3.0
+
+    def validate(self) -> None:
+        _require_non_negative(
+            "slos.maxNodePhaseSeconds", self.max_node_phase_seconds
+        )
+        _require_non_negative("slos.drainP99Seconds", self.drain_p99_seconds)
+        _require_non_negative(
+            "slos.fleetCompletionDeadlineSeconds",
+            self.fleet_completion_deadline_seconds,
+        )
+        if self.straggler_factor <= 0:
+            raise ValidationError(
+                "slos.stragglerFactor must be > 0, got "
+                f"{self.straggler_factor!r}"
+            )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.max_node_phase_seconds:
+            out["maxNodePhaseSeconds"] = self.max_node_phase_seconds
+        if self.drain_p99_seconds:
+            out["drainP99Seconds"] = self.drain_p99_seconds
+        if self.fleet_completion_deadline_seconds:
+            out["fleetCompletionDeadlineSeconds"] = (
+                self.fleet_completion_deadline_seconds
+            )
+        if self.straggler_factor != 3.0:
+            out["stragglerFactor"] = self.straggler_factor
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SloSpec":
+        return cls(
+            max_node_phase_seconds=d.get("maxNodePhaseSeconds", 0.0),
+            drain_p99_seconds=d.get("drainP99Seconds", 0.0),
+            fleet_completion_deadline_seconds=d.get(
+                "fleetCompletionDeadlineSeconds", 0.0
+            ),
+            straggler_factor=d.get("stragglerFactor", 3.0),
+        )
+
+
+@dataclass
 class UpgradePolicySpec:
     """Policy for automatic component upgrades across the fleet.
 
@@ -472,6 +542,11 @@ class UpgradePolicySpec:
     #: retry budgets (see :class:`RemediationSpec`).  None disables the
     #: remediation engine entirely (reference behavior).
     remediation: Optional[RemediationSpec] = None
+    #: Rollout SLOs evaluated each reconcile over the flight recorder's
+    #: phase timelines (see :class:`SloSpec`); report-only.  None
+    #: disables SLO evaluation (analytics stay available on demand via
+    #: the ``slo`` CLI / ``/debug/slo``).
+    slos: Optional[SloSpec] = None
 
     def __post_init__(self) -> None:
         if isinstance(self.max_unavailable, (int, str)):
@@ -520,6 +595,7 @@ class UpgradePolicySpec:
             self.pre_drain_checkpoint,
             self.validation,
             self.remediation,
+            self.slos,
         ):
             if sub is not None:
                 sub.validate()
@@ -564,6 +640,8 @@ class UpgradePolicySpec:
             out["cacheSyncTimeoutSeconds"] = self.cache_sync_timeout_second
         if self.remediation is not None:
             out["remediation"] = self.remediation.to_dict()
+        if self.slos is not None:
+            out["slos"] = self.slos.to_dict()
         return out
 
     @classmethod
@@ -614,6 +692,11 @@ class UpgradePolicySpec:
             remediation=(
                 RemediationSpec.from_dict(d["remediation"])
                 if d.get("remediation") is not None
+                else None
+            ),
+            slos=(
+                SloSpec.from_dict(d["slos"])
+                if d.get("slos") is not None
                 else None
             ),
         )

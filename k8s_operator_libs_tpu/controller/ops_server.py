@@ -21,7 +21,17 @@ server exposing
   raw span dicts, ``?trace_id=...`` filters to one trace;
 * ``GET /debug/remediation`` — the remediation engine's latest decision
   (breaker state, LKG records, quarantines) when a *remediation_source*
-  was wired (usually ``manager.remediation_status``); 404 otherwise.
+  was wired (usually ``manager.remediation_status``); 404 otherwise;
+* ``GET /debug/slo`` — the SLO engine's latest report (ETA, stragglers,
+  breaches, burn rates) when an *slo_source* was wired (usually
+  ``manager.slo_status``); 404 otherwise;
+* ``GET /debug/timeline`` — the flight recorder's per-node phase
+  timelines when a *timeline_source* was wired (usually
+  ``manager.timeline_status``); ``?node=<name>`` filters to one node
+  (404 when the node has no timeline);
+* ``GET /debug`` — JSON index of the debug endpoints registered on THIS
+  server (so an operator can discover what is wired without guessing
+  paths).
 
 ``/metrics`` also honors ``Accept: application/openmetrics-text`` with
 the OpenMetrics rendering, whose histogram ``+Inf`` bucket lines carry
@@ -77,6 +87,8 @@ class OpsServer:
         registry: Optional[metrics_mod.MetricsRegistry] = None,
         tracer: Optional[tracing_mod.Tracer] = None,
         remediation_source: Optional[Callable[[], Optional[dict]]] = None,
+        slo_source: Optional[Callable[[], Optional[dict]]] = None,
+        timeline_source: Optional[Callable[..., dict]] = None,
     ) -> None:
         # All-interfaces default, like controller-runtime's metrics/probe
         # listeners: kubelet probes and Prometheus scrapes arrive on the
@@ -88,6 +100,35 @@ class OpsServer:
         #: Callable returning the remediation engine's latest decision
         #: dict (None = no pass yet); absent means the endpoint 404s.
         self._remediation_source = remediation_source
+        #: Callable returning the SLO engine's latest report dict
+        #: (None = no evaluation yet); absent means /debug/slo 404s.
+        self._slo_source = slo_source
+        #: Callable returning the flight recorder's snapshot dict;
+        #: absent means /debug/timeline 404s.  Arity is resolved ONCE
+        #: here (not with a per-request ``except TypeError``, which
+        #: would misread a TypeError raised INSIDE the source as "no-arg
+        #: source" and silently serve the slow whole-fleet path): a
+        #: source accepting an argument gets the ?node= filter pushed
+        #: down (``FlightRecorder.snapshot(node)`` — no fleet-wide
+        #: serialization per single-node query).
+        self._timeline_source = timeline_source
+        self._timeline_takes_node = False
+        if timeline_source is not None:
+            import inspect
+
+            try:
+                params = inspect.signature(timeline_source).parameters
+                self._timeline_takes_node = any(
+                    p.kind
+                    in (
+                        inspect.Parameter.POSITIONAL_ONLY,
+                        inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                        inspect.Parameter.VAR_POSITIONAL,
+                    )
+                    for p in params.values()
+                )
+            except (TypeError, ValueError):  # uninspectable callable
+                self._timeline_takes_node = False
         self._health_checks: Dict[str, Check] = {}
         self._ready_checks: Dict[str, Check] = {}
         self._lock = threading.Lock()
@@ -203,6 +244,69 @@ class OpsServer:
                 200,
                 "application/json",
                 (json.dumps(payload) + "\n").encode(),
+            )
+        if path == "/debug/slo":
+            if self._slo_source is None:
+                return (
+                    404,
+                    "text/plain; charset=utf-8",
+                    b"slo engine not configured\n",
+                )
+            payload = {"configured": True, "report": self._slo_source()}
+            return (
+                200,
+                "application/json",
+                (json.dumps(payload) + "\n").encode(),
+            )
+        if path == "/debug/timeline":
+            if self._timeline_source is None:
+                return (
+                    404,
+                    "text/plain; charset=utf-8",
+                    b"flight recorder not configured\n",
+                )
+            node = (parse_qs(raw_query).get("node") or [""])[0]
+            if node:
+                # filter at the SOURCE when it supports it (the flight
+                # recorder does): a single-node query must not
+                # serialize the whole fleet's timelines per hit
+                if self._timeline_takes_node:
+                    snapshot = self._timeline_source(node) or {}
+                else:
+                    snapshot = self._timeline_source() or {}
+                hits = [
+                    t
+                    for t in snapshot.get("timelines") or []
+                    if t.get("node") == node
+                ]
+                if not hits:
+                    return (
+                        404,
+                        "text/plain; charset=utf-8",
+                        f"no timeline for node {node}\n".encode(),
+                    )
+                snapshot = dict(snapshot, nodes=len(hits), timelines=hits)
+            else:
+                snapshot = self._timeline_source() or {}
+            return (
+                200,
+                "application/json",
+                (json.dumps(snapshot) + "\n").encode(),
+            )
+        if path in ("/debug", "/debug/"):
+            # Discovery index instead of a 404: which debug endpoints
+            # are actually registered on THIS server.
+            endpoints = ["/debug/traces"]
+            if self._remediation_source is not None:
+                endpoints.append("/debug/remediation")
+            if self._slo_source is not None:
+                endpoints.append("/debug/slo")
+            if self._timeline_source is not None:
+                endpoints.append("/debug/timeline")
+            return (
+                200,
+                "application/json",
+                (json.dumps({"endpoints": endpoints}) + "\n").encode(),
             )
         return 404, "text/plain; charset=utf-8", b"404 not found\n"
 

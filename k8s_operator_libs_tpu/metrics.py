@@ -616,6 +616,94 @@ def record_node_quarantine() -> None:
     ).inc()
 
 
+def _slo_gauge_families() -> tuple:
+    """The five SLO gauge families, shared by publish and retire so
+    their definitions exist exactly once: (phase_seconds, eta,
+    stragglers, burn_rate, breached)."""
+    reg = default_registry()
+    return (
+        reg.gauge(
+            "slo_phase_seconds",
+            "Observed per-phase latency quantiles from the flight recorder.",
+            ("phase", "quantile"),
+        ),
+        reg.gauge(
+            "rollout_eta_seconds",
+            "Projected seconds until the rollout completes (0 when "
+            "complete; -1 while unknown, i.e. fewer than 2 completions "
+            "observed).",
+        ),
+        reg.gauge(
+            "rollout_stragglers",
+            "Nodes currently exceeding k x their phase's p95 wall clock.",
+        ),
+        reg.gauge(
+            "slo_burn_rate",
+            "Per-SLO budget burn rate (1.0 = exactly on target).",
+            ("slo",),
+        ),
+        reg.gauge(
+            "slo_breached",
+            "Per-SLO breach position (1 = currently breached).",
+            ("slo",),
+        ),
+    )
+
+
+def publish_slo_gauges(
+    phase_quantiles: Dict[Tuple[str, str], float],
+    eta_seconds: Optional[float],
+    stragglers: int,
+    burn_rates: Dict[str, float],
+    breached,
+) -> None:
+    """Rollout SLO engine state, re-published each reconcile (see
+    obs/slo.py): per-phase latency quantiles, the completion ETA,
+    straggler count, and the per-SLO burn-rate / breach position.
+    Families are atomically replaced so a phase that emptied (or an SLO
+    removed from the policy) disappears from the exposition instead of
+    freezing at its last value."""
+    phase_g, eta_g, straggler_g, burn_g, breached_g = _slo_gauge_families()
+    phase_g.replace(
+        {
+            (phase, q): seconds
+            for (phase, q), seconds in phase_quantiles.items()
+        }
+    )
+    eta_g.set(-1 if eta_seconds is None else eta_seconds)
+    straggler_g.set(stragglers)
+    burn_g.replace({(name,): rate for name, rate in burn_rates.items()})
+    breached_g.replace(
+        {
+            (name,): (1.0 if name in breached else 0.0)
+            for name in set(burn_rates) | set(breached)
+        }
+    )
+
+
+def record_slo_breach(slo: str) -> None:
+    """A declared rollout SLO newly entered breach (edge-triggered by
+    the engine — reconciles SPENT in breach do not re-count)."""
+    default_registry().counter(
+        "slo_breaches_total",
+        "Declared rollout SLOs newly entering breach, by SLO.",
+        ("slo",),
+    ).inc(slo)
+
+
+def retire_slo_gauges() -> None:
+    """The policy lost its ``slos`` block: REMOVE every SLO series from
+    the exposition (the breach counter, being a counter, is left
+    alone).  Removal, not zeroing: a retired ``rollout_eta_seconds``
+    stuck at -1 would keep matching the ETA-stalled alert for the rest
+    of a rollout whose SLO tracking was intentionally turned off —
+    which is also why this clears directly instead of publishing
+    empties first (a scrape must never land between a -1 write and its
+    removal)."""
+    for gauge in _slo_gauge_families():
+        gauge.clear()
+
+
 def record_leader_transition(event: str) -> None:
     """Leader-election lifecycle: acquired | lost | released."""
     default_registry().counter(

@@ -8,6 +8,7 @@ live in :mod:`..metrics` (predating this package); the HTTP surface for
 both is :class:`~..controller.ops_server.OpsServer`.
 """
 
+from . import slo
 from .tracing import (
     Span,
     TraceContextFilter,
@@ -29,6 +30,7 @@ from .tracing import (
 )
 
 __all__ = [
+    "slo",
     "Span",
     "TraceContextFilter",
     "Tracer",

@@ -32,6 +32,11 @@ from .remediation import (
 )
 from .safe_driver_load_manager import SafeDriverLoadManager
 from .state_index import ClusterStateIndex
+from .timeline import (
+    FlightRecorder,
+    default_recorder,
+    set_default_recorder,
+)
 from .upgrade_inplace import InplaceNodeStateManager
 from .upgrade_requestor import (
     DEFAULT_NODE_MAINTENANCE_NAME_PREFIX,
@@ -74,6 +79,9 @@ __all__ = [
     "render_report",
     "SafeDriverLoadManager",
     "ClusterStateIndex",
+    "FlightRecorder",
+    "default_recorder",
+    "set_default_recorder",
     "InplaceNodeStateManager",
     "DEFAULT_NODE_MAINTENANCE_NAME_PREFIX",
     "NodeMaintenanceUpgradeDisabledError",

@@ -203,6 +203,14 @@ UPGRADE_FAILURE_TARGET_ANNOTATION_KEY_FMT = (
 #: (effect NoSchedule); removed when the quarantine is released.
 UPGRADE_QUARANTINE_TAINT_KEY_FMT = DOMAIN + "/%s-upgrade.quarantined"
 
+#: Node annotation carrying the flight recorder's timeline CHECKPOINT
+#: (compact JSON: current phase + recent closed intervals).  Written by
+#: the state provider in the SAME patch as every state-label change, so
+#: per-node phase timelines survive operator crash / HA failover the
+#: way remediation state does — the next leader reloads them from the
+#: node objects already in its snapshot (see upgrade/timeline.py).
+UPGRADE_TIMELINE_ANNOTATION_KEY_FMT = DOMAIN + "/%s-upgrade.timeline"
+
 #: Value prefix marking a quarantine annotation as REMEDIATION-owned
 #: (retry budget exhausted) rather than health-owned; the
 #: SliceHealthManager only lifts health-owned quarantines.

@@ -25,7 +25,7 @@ from ..cluster.cache import InformerCache
 from ..cluster.errors import NotFoundError
 from ..cluster.client import ClusterClient
 from ..cluster.inmem import JsonObj
-from . import consts, util
+from . import consts, timeline as timeline_mod, util
 from .util import EventRecorder, KeyedMutex, log_event
 
 logger = logging.getLogger(__name__)
@@ -112,10 +112,15 @@ class NodeUpgradeStateProvider:
         recorder: Optional[EventRecorder] = None,
         cache_sync_timeout_seconds: float = DEFAULT_CACHE_SYNC_TIMEOUT_SECONDS,
         cache_sync_poll_seconds: float = DEFAULT_CACHE_SYNC_POLL_SECONDS,
+        flight_recorder: Optional["timeline_mod.FlightRecorder"] = None,
     ) -> None:
         self._cluster = cluster
         self._cache = cache
         self._recorder = recorder
+        #: Flight recorder fed by every state-label write (None = resolve
+        #: the process default per call, so tests swapping the default
+        #: recorder keep their isolation with long-lived providers).
+        self._flight = flight_recorder
         self._keyed_mutex = KeyedMutex()
         self._timeout = cache_sync_timeout_seconds
         self._poll = cache_sync_poll_seconds
@@ -173,6 +178,23 @@ class NodeUpgradeStateProvider:
             patch["metadata"]["annotations"] = {
                 util.get_done_at_annotation_key(): done_stamp
             }
+        # Flight-recorder checkpoint rides the SAME patch too, for the
+        # same crash-split reason: the per-node phase timeline must
+        # survive operator failover without a second write.  Recorded
+        # optimistically (like the in-place node mutation below); a
+        # failed patch is corrected by the next observation sweep.
+        # `is None`, not truthiness: an EMPTY injected recorder is falsy
+        # (len() == 0) but still the one the caller chose
+        flight = (
+            self._flight
+            if self._flight is not None
+            else timeline_mod.default_recorder()
+        )
+        checkpoint = flight.transition(node, new_state)
+        if checkpoint is not None:
+            patch["metadata"].setdefault("annotations", {})[
+                util.get_timeline_annotation_key()
+            ] = checkpoint
         if not self._submit_patch(name, patch):
             with self._keyed_mutex.lock(name):
                 updated = self._cluster.patch("Node", name, patch)
@@ -186,6 +208,10 @@ class NodeUpgradeStateProvider:
             node["metadata"].setdefault("annotations", {})[
                 util.get_done_at_annotation_key()
             ] = done_stamp
+        if checkpoint is not None:
+            node["metadata"].setdefault("annotations", {})[
+                util.get_timeline_annotation_key()
+            ] = checkpoint
         metrics.record_state_transition(new_state)
         listener = getattr(self._local, "listener", None)
         if listener is not None:

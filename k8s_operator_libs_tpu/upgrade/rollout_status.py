@@ -19,13 +19,53 @@ a persisted cluster dump.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ..tpu import health, topology
 from . import consts
 
 #: Terminal/OK state for display purposes.
 _DONE = consts.UPGRADE_STATE_DONE
+
+
+def bucket_census(state) -> Dict[str, object]:
+    """THE bucket→counter classification, shared by
+    :class:`RolloutStatus` and the SLO engine (:mod:`..obs.slo`) — one
+    definition, so ``/debug/slo``'s counts and the deadline burn rate
+    can never disagree with the status the operator reads next to them.
+    Counter semantics per :class:`RolloutStatus`: ``failed`` is a
+    subset of ``inProgress``; done + inProgress + pending + unknown
+    always sums to total."""
+    by_state: Dict[str, int] = {}
+    total = done = in_progress = pending = unknown = failed = 0
+    for bucket, node_states in state.node_states.items():
+        n = len(node_states)
+        total += n
+        # UPGRADE_STATE_UNKNOWN is the empty string; surface it under a
+        # readable key so JSON consumers don't special-case "".
+        label = bucket or "unknown"
+        by_state[label] = by_state.get(label, 0) + n
+        if bucket == _DONE:
+            done += n
+        elif bucket == consts.UPGRADE_STATE_UPGRADE_REQUIRED:
+            pending += n
+        elif bucket in consts.ACTIVE_STATES:
+            in_progress += n
+        else:
+            # no state label yet, or a corrupted/unrecognized one —
+            # either way the bucket counts toward the invariant
+            unknown += n
+        if bucket == consts.UPGRADE_STATE_FAILED:
+            failed += n
+    return {
+        "total": total,
+        "done": done,
+        "pending": pending,
+        "inProgress": in_progress,
+        "failed": failed,
+        "unknown": unknown,
+        "byState": by_state,
+    }
 
 
 @dataclass
@@ -118,6 +158,11 @@ class RolloutStatus:
     #: :meth:`from_cluster_state` (empty otherwise — gates are
     #: policy-defined).
     gates: List[GateStatus] = field(default_factory=list)
+    #: SLO engine report (obs/slo.py) — ETA, stragglers, breaches —
+    #: attached when the caller passes one to :meth:`from_cluster_state`
+    #: (the live operator's last report, or the ``slo``/``status``
+    #: CLI's offline reconstruction).  None = not evaluated.
+    slo: Optional[dict] = None
 
     # ------------------------------------------------------------- derived
     @property
@@ -142,33 +187,20 @@ class RolloutStatus:
 
     # --------------------------------------------------------- construction
     @classmethod
-    def from_cluster_state(cls, state, policy=None) -> "RolloutStatus":
+    def from_cluster_state(
+        cls, state, policy=None, slo_report=None
+    ) -> "RolloutStatus":
         """Compute from a :class:`~.common_manager.ClusterUpgradeState`
         snapshot (the object ``build_state`` returns).  Pass the active
         *policy* to also evaluate the admission gates (canary, window,
-        pacing) and explain any freeze."""
-        by_state: Dict[str, int] = {}
+        pacing) and explain any freeze; pass an SLO engine report
+        (*slo_report*) to surface ETA / stragglers / breaches beside
+        them."""
+        census = bucket_census(state)
         domains: Dict[str, DomainStatus] = {}
-        total = done = in_progress = pending = unknown = failed = 0
         for bucket, node_states in state.node_states.items():
-            # UPGRADE_STATE_UNKNOWN is the empty string; surface it under a
-            # readable key so JSON consumers don't special-case "".
             label = bucket or "unknown"
             for ns in node_states:
-                total += 1
-                by_state[label] = by_state.get(label, 0) + 1
-                if bucket == _DONE:
-                    done += 1
-                elif bucket == consts.UPGRADE_STATE_UPGRADE_REQUIRED:
-                    pending += 1
-                elif bucket in consts.ACTIVE_STATES:
-                    in_progress += 1
-                else:
-                    # no state label yet, or a corrupted/unrecognized one —
-                    # either way the bucket counts toward the invariant
-                    unknown += 1
-                if bucket == consts.UPGRADE_STATE_FAILED:
-                    failed += 1
                 dom = topology.domain_of(ns.node)
                 ds = domains.get(dom)
                 if ds is None:
@@ -183,17 +215,19 @@ class RolloutStatus:
                 if health.node_is_degraded(ns.node):
                     ds.degraded = True
         status = cls(
-            total_nodes=total,
-            by_state=by_state,
-            done=done,
-            in_progress=in_progress,
-            pending=pending,
-            failed=failed,
-            unknown=unknown,
+            total_nodes=census["total"],
+            by_state=census["byState"],
+            done=census["done"],
+            in_progress=census["inProgress"],
+            pending=census["pending"],
+            failed=census["failed"],
+            unknown=census["unknown"],
             domains=sorted(domains.values(), key=lambda d: d.domain),
         )
         if policy is not None:
             status.gates = _evaluate_gates(state, policy)
+        if slo_report is not None:
+            status.slo = dict(slo_report)
         return status
 
     # ------------------------------------------------------------- derived
@@ -217,7 +251,39 @@ class RolloutStatus:
         }
         if self.gates:
             out["gates"] = [g.to_dict() for g in self.gates]
+        if self.slo is not None:
+            out["slo"] = dict(self.slo)
         return out
+
+    # ---------------------------------------------------------- SLO summary
+    def _slo_bits(self) -> List[str]:
+        """Short ETA / straggler / first-breach fragments from the
+        attached SLO report (empty without one)."""
+        if self.slo is None:
+            return []
+        bits: List[str] = []
+        eta = self.slo.get("eta") or {}
+        if eta.get("seconds") is not None and not self.complete:
+            bits.append(
+                f"ETA {eta['seconds']:.0f}s "
+                f"(p50 {eta.get('p50Seconds', 0):.0f}s / "
+                f"p95 {eta.get('p95Seconds', 0):.0f}s)"
+            )
+        stragglers = self.slo.get("stragglers") or []
+        if stragglers:
+            worst = stragglers[0]
+            bits.append(
+                f"{len(stragglers)} straggler(s), worst {worst['node']} "
+                f"({worst['elapsedSeconds']:.0f}s in {worst['phase']})"
+            )
+        breaches = (self.slo.get("slos") or {}).get("breaches") or []
+        if breaches:
+            first = breaches[0]
+            bits.append(
+                f"SLO BREACH [{first['slo']}]: "
+                + (first.get("detail") or f"observed {first['observed']}")
+            )
+        return bits
 
     def summary(self, lead_gate: bool = True) -> str:
         """One-line progress summary (the kubectl-rollout-status analog).
@@ -242,6 +308,11 @@ class RolloutStatus:
                 line += " — also gated: " + "; ".join(
                     g.reason for g in blocking[1:]
                 )
+        # the standalone one-liner carries the SLO fragments too;
+        # render() (lead_gate=False) prints them as its own block instead
+        bits = self._slo_bits()
+        if lead_gate and bits:
+            line += " — " + "; ".join(bits)
         return line
 
     def render(self) -> str:
@@ -259,6 +330,12 @@ class RolloutStatus:
             lines.append("admission gates:")
             for g in blocking:
                 lines.append(f"  [{g.gate}] {g.reason}")
+            lines.append("")
+        bits = self._slo_bits()
+        if bits:
+            lines.append("rollout SLOs:")
+            for bit in bits:
+                lines.append(f"  {bit}")
             lines.append("")
         header = (
             f"{'DOMAIN':<28} {'NODES':>5} {'UNAVAIL':>7} {'DEGRADED':>8}  STATES"

@@ -27,6 +27,7 @@ from contextlib import nullcontext
 from typing import Dict, List, Optional, Tuple
 
 from .. import metrics
+from ..obs import slo as slo_mod
 from ..obs import tracing
 from ..api.upgrade_spec import UpgradePolicySpec
 from ..cluster.cache import InformerCache
@@ -34,7 +35,7 @@ from ..cluster.errors import NotFoundError
 from ..cluster.client import ClusterClient
 from ..cluster.inmem import JsonObj
 from ..cluster.selectors import labels_to_selector
-from . import consts, util
+from . import consts, timeline as timeline_mod, util
 from .common_manager import (
     ClusterUpgradeState,
     CommonUpgradeManager,
@@ -76,6 +77,7 @@ class ClusterUpgradeStateManager:
         cache_sync_poll_seconds: float = 1.0,
         use_state_index: bool = False,
         state_index: Optional[ClusterStateIndex] = None,
+        flight_recorder: Optional[timeline_mod.FlightRecorder] = None,
         # test injection points (the reference wires mocks the same way,
         # upgrade_suit_test.go:114-182)
         provider: Optional[NodeUpgradeStateProvider] = None,
@@ -101,6 +103,15 @@ class ClusterUpgradeStateManager:
         #: Synchronous state transitions performed by the most recent
         #: apply_state pass (see that method's docstring).
         self.last_apply_transitions = 0
+        #: Flight recorder (upgrade/timeline.py): per-node phase
+        #: timelines derived from the transitions the machine makes.
+        #: None resolves the process default per use (test-swap
+        #: friendly, like the tracer/registry); inject a disabled
+        #: recorder to A/B the overhead (bench does).
+        self._flight = flight_recorder
+        #: SLO engine (obs/slo.py): evaluates the policy's optional
+        #: ``slos`` block each reconcile — entirely inert without one.
+        self._slo_engine = slo_mod.SloEngine(flight_recorder)
         self._owned_provider = provider is None
         self._provider = provider or NodeUpgradeStateProvider(
             cluster,
@@ -108,6 +119,7 @@ class ClusterUpgradeStateManager:
             recorder,
             cache_sync_timeout_seconds=cache_sync_timeout_seconds,
             cache_sync_poll_seconds=cache_sync_poll_seconds,
+            flight_recorder=flight_recorder,
         )
         self._cordon_manager = cordon_manager or CordonManager(cluster, recorder)
         # One bounded worker pool per operator, shared by the drain and pod
@@ -297,6 +309,27 @@ class ClusterUpgradeStateManager:
         the first reconcile under a remediation-enabled policy."""
         return self._remediation.last_status()
 
+    # -------------------------------------------------- flight recorder / SLO
+    @property
+    def flight_recorder(self) -> timeline_mod.FlightRecorder:
+        """The recorder feeding timelines/SLO analytics (the injected
+        one, else the process default).  `is None`, not truthiness: an
+        empty injected recorder is falsy (len() == 0) but still chosen."""
+        if self._flight is not None:
+            return self._flight
+        return timeline_mod.default_recorder()
+
+    def slo_status(self) -> Optional[dict]:
+        """The most recent SLO report as a JSON-able dict — the
+        ``OpsServer GET /debug/slo`` payload.  None before the first
+        reconcile under a policy declaring an ``slos`` block."""
+        return self._slo_engine.last_report()
+
+    def timeline_status(self, node: Optional[str] = None) -> dict:
+        """The flight recorder's snapshot — the ``OpsServer GET
+        /debug/timeline`` payload (*node* filters at the source)."""
+        return self.flight_recorder.snapshot(node)
+
     # ------------------------------------------------------------ BuildState
     @property
     def state_index(self) -> Optional[ClusterStateIndex]:
@@ -327,8 +360,15 @@ class ClusterUpgradeStateManager:
                     if not state.built_from_index:
                         mode["v"] = "full"
                         span.set_attribute("mode", "full")
-                    return state
-                return self._build_state(namespace, driver_labels)
+                else:
+                    state = self._build_state(namespace, driver_labels)
+                # Flight-recorder sweep: reconcile timelines against the
+                # fresh snapshot (crash-resume checkpoint reload, other
+                # writers' transitions, quarantine episodes).  Scoped by
+                # the snapshot's dirty-node set, so the indexed path
+                # pays O(changed) — see upgrade/timeline.py.
+                self.flight_recorder.observe(state)
+                return state
             finally:
                 # finally: failed snapshots are exactly the slow outliers
                 # the latency histogram exists to surface
@@ -500,6 +540,16 @@ class ClusterUpgradeStateManager:
             # decision so gauges and /debug/remediation don't keep
             # reporting the last breaker position forever.
             self._remediation.disable()
+        if policy is None or policy.slos is None:
+            # Same retirement contract for the SLO engine: a removed
+            # ``slos`` block clears the breach/burn/eta gauges and the
+            # /debug/slo report.
+            self._slo_engine.disable()
+        else:
+            # Report-only evaluation — runs even while the rollout is
+            # paused (auto_upgrade off), because a paused-but-unfinished
+            # rollout is exactly when the deadline burn rate matters.
+            self._slo_engine.evaluate(state, policy)
         if policy is not None:
             self._configure_from_policy(policy)
         else:

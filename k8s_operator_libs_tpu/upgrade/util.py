@@ -236,6 +236,11 @@ def get_failure_target_annotation_key() -> str:
     )
 
 
+def get_timeline_annotation_key() -> str:
+    """Flight recorder: per-node timeline checkpoint annotation key."""
+    return consts.UPGRADE_TIMELINE_ANNOTATION_KEY_FMT % get_component_name()
+
+
 def get_quarantine_taint_key() -> str:
     """Remediation: NoSchedule taint key for quarantined nodes."""
     return consts.UPGRADE_QUARANTINE_TAINT_KEY_FMT % get_component_name()

@@ -64,6 +64,19 @@ def fresh_tracer():
 
 
 @pytest.fixture(autouse=True)
+def fresh_flight_recorder():
+    """Per-test flight-recorder isolation: the default recorder is
+    process-global (like the tracer); a fresh one per test keeps phase
+    timelines from leaking across tests while the always-on hook stays
+    exercised everywhere."""
+    from k8s_operator_libs_tpu.upgrade import timeline
+
+    previous = timeline.set_default_recorder(timeline.FlightRecorder())
+    yield
+    timeline.set_default_recorder(previous)
+
+
+@pytest.fixture(autouse=True)
 def reset_topology_label_keys():
     """Per-policy topology key overrides are process-global (like the
     component name); restore defaults between tests."""

@@ -385,6 +385,14 @@ class TestAlertRulesStayInSync:
             m.record_watch_expired("Node")
             m.record_held_queue_overflow()
             m.set_held_queue_depth(0)
+            m.publish_slo_gauges(
+                {("drain-required", "p95"): 1.0},
+                120.0,
+                1,
+                {"drainP99Seconds": 0.5},
+                set(),
+            )
+            m.record_slo_breach("drainP99Seconds")
             exposition = registry.render()
         finally:
             m.set_default_registry(prev)
