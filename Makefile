@@ -9,7 +9,7 @@ DOCKER ?= docker
 IMAGE ?= k8s-operator-libs-tpu:dev
 BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 
-.PHONY: all test test-fast lint bench bench-scale smoke graft-check cov \
+.PHONY: all test test-fast lint bench bench-scale bench-http smoke graft-check cov \
 	cov-report clean help image .build-image kind-e2e kind-e2e-stub \
 	tpu-smoke tpu-probe tpu-watch tpu-stage verify-obs verify-remediation \
 	verify-slo
@@ -75,6 +75,14 @@ bench:
 # tests/test_state_index.py (TestListOpsGuard).
 bench-scale:
 	$(PYTHON) bench.py --scale-only
+
+# HTTP-path A/B only: the 1,024-node rollout over real localhost HTTP
+# with the write pipeline on vs off, plus the same fleet in-mem as the
+# transport-gap yardstick — prints ONE compact JSON line, so the
+# write-pipeline 2x target (http_vs_inmem_1024n <= 2) is checkable
+# without the full bench.
+bench-http:
+	$(PYTHON) bench.py --http-only
 
 # The minimum end-to-end slice: CRD apply/delete via the example CLI.
 smoke:

@@ -29,7 +29,11 @@ informer lag, twice:
   runs just this section as one compact JSON line;
 * **HTTP path** — the same tuned rollout over real localhost HTTP:
   ApiServerFacade with server-enforced 500-item pages + KubeApiClient
-  held watch streams (the production read path) → ``detail.http_*``;
+  held watch streams (the production read path) and the async batched
+  write pipeline, A/B'd against sequential per-write round trips
+  (``detail.http_pipeline_speedup``, ``detail.http_vs_inmem_1024n``)
+  → ``detail.http_*``; ``--http-only`` (``make bench-http``) runs just
+  this A/B as one compact JSON line;
 * **TPU silicon** — the demo trainer's measured step time / tokens/s
   plus the checkpoint-on-drain handshake, when a chip is visible —
   probe-first with an age-labeled cached-capture fallback
@@ -56,11 +60,9 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
 
-logging.disable(logging.WARNING)
-
 from k8s_operator_libs_tpu.api import DrainSpec, IntOrString, UpgradePolicySpec
 from k8s_operator_libs_tpu.cluster import InformerCache, InMemoryCluster
-from k8s_operator_libs_tpu.runtime import tuned_gc
+from k8s_operator_libs_tpu.runtime import tuned_gc, tuned_scheduler
 from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager, consts, util
 
 from harness import DRIVER_LABELS, NAMESPACE, Fleet
@@ -165,8 +167,22 @@ def run_rollout_http(
     facade = ApiServerFacade(store, max_list_page=max_list_page).start()
     client = KubeApiClient(KubeConfig(server=facade.url), timeout=30.0)
     try:
-        fleet = (fleet_builder or build_fleet)(client)
-        client.start_held_watches(("Node", "Pod", "DaemonSet"))
+        # The Fleet harness models the DS controller + kubelets — in a
+        # real cluster those are OTHER processes talking to their own
+        # apiserver connections, not part of the operator's transport
+        # path this probe isolates.  It drives the STORE directly (its
+        # writes still flow through the journal into the operator's
+        # held streams), exactly as the in-mem measurement's fleet
+        # does, so the A/B compares the operator loop transport apples
+        # to apples and `requests_served` counts operator traffic only.
+        fleet = (fleet_builder or build_fleet)(store)
+        # held coverage must equal the cache's working set: an uncovered
+        # cached kind costs one bounded-watch round trip per refresh AND
+        # forces the refresh's journal head probe (cache.py elides it
+        # only under full held coverage)
+        client.start_held_watches(
+            ("Node", "Pod", "DaemonSet", "ControllerRevision")
+        )
         # kinds: the manager's working set — an unfiltered cache would
         # bounded-poll the 8 non-held registered kinds over HTTP on
         # every refresh, billing 8 extra round trips to the number this
@@ -189,10 +205,25 @@ def run_rollout_http(
             # held-stream-fed informer cache, not per-cycle HTTP LISTs
             reads_from_cache=True,
         )
+        from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+            UpgradeStateError,
+        )
+
         served_before = facade.requests_served
         t0 = time.monotonic()
         for _ in range(max_cycles):
-            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            try:
+                state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            except UpgradeStateError:
+                # torn cache read: the held Pod/DaemonSet streams are
+                # per-kind and async, so right after a whole wave's pod
+                # recreate lands (store-direct, like a real
+                # DS controller on its own apiserver connection) the cache
+                # can show the DS's desired count ahead of the pod
+                # frames still on the wire.  The production controller
+                # requeues on build errors; the bench loop models that.
+                time.sleep(0.005)
+                continue
             manager.apply_state(state, policy)
             manager.drain_manager.wait_idle(30.0)
             manager.pod_manager.wait_idle(30.0)
@@ -892,7 +923,21 @@ def main() -> None:
             )
             for _ in range(2)
         )
+        # Same-lag in-mem yardstick for the transport ratio — see
+        # http_main: identical engine + informer lag on both sides,
+        # only the transport differs.  The lag-0 ceiling stays
+        # reported via scale_1024_nodes_per_min.
+        inmem_1k_lag_s = min(
+            run_rollout(
+                tuned_policy,
+                cascade=True,
+                fleet_builder=lambda c: build_big_fleet(c, 256, 4),
+                lag_seconds=INFORMER_LAG_S,
+            )
+            for _ in range(2)
+        )
     http_1k_rate = 1024 / (http_1k_s / 60.0)
+    inmem_1k_lag_rate = 1024 / (inmem_1k_lag_s / 60.0)
 
     # vs_baseline is the ENGINE-honest ratio (full engine vs all
     # features off, same policy both sides — VERDICT r3 weak #4); the
@@ -949,17 +994,25 @@ def main() -> None:
                         "(client-go pager default) + operator GC profile "
                         "+ 16-worker write pipeline"
                     ),
-                    "http_write_pipeline_speedup_1024n": round(
+                    "http_pipeline_speedup": round(
                         http_1k_seq_s / http_1k_s, 3
                     ),
+                    "http_vs_inmem_1024n": round(
+                        inmem_1k_lag_rate / http_1k_rate, 3
+                    ),
+                    "inmem_lagged_1024_nodes_per_min": round(
+                        inmem_1k_lag_rate, 2
+                    ),
+                    "http_vs_inmem_ceiling_1024n": round(
+                        scale["scale_1024_nodes_per_min"] / http_1k_rate, 3
+                    ),
                     "http_scale_gap": (
-                        "vs in-mem: every node transition is a JSON "
-                        "merge-patch over HTTP (~1ms Python http stack "
-                        "round trip, ~14 requests/node incl. pod "
-                        "delete/create + eviction), where the in-mem "
-                        "store applies it in ~30us; the write pipeline "
-                        "overlaps the patches (A/B above), the rest is "
-                        "transport serialization"
+                        "http_vs_inmem_1024n is the controlled A/B: "
+                        "identical engine + informer lag both sides, "
+                        "only the transport differs (batched write "
+                        "pipeline + held streams vs in-process store); "
+                        "the _ceiling_ ratio compares against the lag-0 "
+                        "in-mem max instead"
                     ),
                     "policy_vs_default": round(tuned_rate / baseline_rate, 3),
                     "baseline_config_nodes_per_min": round(baseline_rate, 2),
@@ -1039,6 +1092,69 @@ def compact_result(result: dict) -> dict:
     return compact
 
 
+def http_main() -> None:
+    """``python bench.py --http-only`` (``make bench-http``): ONLY the
+    HTTP-path A/B probe — the 1,024-node rollout over real localhost
+    HTTP with the write pipeline on vs off, plus the same fleet in-mem
+    as the transport-gap yardstick — as ONE compact JSON line on
+    stdout.  The write-pipeline 2x target (`http_vs_inmem_1024n` <= 2)
+    is checkable in a fraction of the full bench's wall clock."""
+    util.set_component_name("tpu-runtime")
+    _, tuned_policy = bench_policies()
+    fleet_1k = lambda c: build_big_fleet(c, 256, 4)  # noqa: E731
+    with tuned_gc(), tuned_scheduler():
+        # Same-lag yardstick: the controlled transport A/B.  BOTH sides
+        # run the identical engine + informer lag; only the transport
+        # (in-process store vs HTTP apiserver + held streams) differs.
+        # The lag-0 in-mem ceiling is a DIFFERENT experiment (the
+        # engine's own max, scale_1024_nodes_per_min in the full bench).
+        inmem_s = best_of(
+            2,
+            lambda: run_rollout(
+                tuned_policy,
+                cascade=True,
+                fleet_builder=fleet_1k,
+                lag_seconds=INFORMER_LAG_S,
+            ),
+        )
+        http_s, http_req = min(
+            run_rollout_http(
+                tuned_policy, fleet_builder=fleet_1k, max_list_page=500
+            )
+            for _ in range(2)
+        )
+        http_seq_s, _ = min(
+            run_rollout_http(
+                tuned_policy,
+                fleet_builder=fleet_1k,
+                max_list_page=500,
+                write_pipeline_workers=0,
+            )
+            for _ in range(2)
+        )
+    inmem_rate = 1024 / (inmem_s / 60.0)
+    http_rate = 1024 / (http_s / 60.0)
+    detail = {
+        "http_nodes_per_min": round(http_rate, 2),
+        "http_scale_1024_nodes_per_min": round(http_rate, 2),
+        "http_scale_1024_wall_s": round(http_s, 2),
+        "http_scale_1024_requests_per_s": round(http_req / http_s, 1),
+        "http_sequential_1024_wall_s": round(http_seq_s, 2),
+        "http_pipeline_speedup": round(http_seq_s / http_s, 3),
+        "inmem_1024_nodes_per_min": round(inmem_rate, 2),
+        "http_vs_inmem_1024n": round(inmem_rate / http_rate, 3),
+        "inmem_lag_s": INFORMER_LAG_S,
+    }
+    result = {
+        "metric": "http_nodes_per_min",
+        "value": round(http_rate, 2),
+        "unit": "nodes/min",
+        "vs_baseline": detail["http_pipeline_speedup"],
+        "detail": detail,
+    }
+    print(json.dumps(compact_result(result), separators=(",", ":")))
+
+
 def scale_main() -> None:
     """``python bench.py --scale-only`` (``make bench-scale``): only the
     fleet-scale probes and the incremental-BuildState A/B — the numbers
@@ -1098,9 +1214,15 @@ def profile_main() -> None:
 
 
 if __name__ == "__main__":
+    # Script-mode only (NOT at import time: tests import this module, and
+    # logging.disable is process-global — leaking it from an import
+    # silently swallows every later test's log assertions).
+    logging.disable(logging.WARNING)
     if "--profile" in sys.argv:
         profile_main()
     elif "--scale-only" in sys.argv:
         scale_main()
+    elif "--http-only" in sys.argv:
+        http_main()
     else:
         main()

@@ -38,7 +38,7 @@ from k8s_operator_libs_tpu.api import (
 )
 from k8s_operator_libs_tpu.cluster import InMemoryCluster
 from k8s_operator_libs_tpu.controller import new_upgrade_controller
-from k8s_operator_libs_tpu.runtime import tune_gc
+from k8s_operator_libs_tpu.runtime import tune_gc, tune_scheduler
 from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager, consts, util
 
 # The in-memory DEMO mode simulates the fleet with the test harness;
@@ -298,6 +298,7 @@ def main() -> int:
     # substrate allocates heavily; default CPython thresholds make GC
     # the dominant super-linear cost at fleet scale (runtime.py)
     tune_gc()
+    tune_scheduler()
     if args.kubeconfig is not None or args.in_cluster:
         return run_real(args)
     if args.ha or args.identity:

@@ -45,7 +45,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, parse_qsl, urlparse
 
 from .client import KindInfo, route_for_path
 from .errors import (
@@ -61,6 +61,15 @@ from .errors import (
 )
 from .inmem import InMemoryCluster, JsonObj
 from .selectors import parse_selector
+from .writepipeline import (
+    BATCH_WRITE_API_VERSION,
+    BATCH_WRITE_PATH,
+    JOURNAL_WAIT_PATH,
+    MAX_BATCH_ITEMS,
+    MAX_JOURNAL_WAIT_SECONDS,
+    apply_write_op,
+    decode_write_op,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -119,6 +128,10 @@ class _Handler(BaseHTTPRequestHandler):
     #: Retry-After and the APF flow-schema header (see ApiServerFacade).
     apf_max_inflight: int = 0
     apf_state: Optional[dict] = None
+    #: Serve the opt-in batch write endpoint (writepipeline.
+    #: BATCH_WRITE_PATH).  False = vanilla-apiserver parity: the path
+    #: 404s and the client transparently degrades to per-op writes.
+    serve_batch_writes: bool = True
 
     def _check_auth(self) -> None:
         if self.accepted_tokens is None:
@@ -191,6 +204,35 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             self._drain_body()
             self._check_auth()
+            # Batch write endpoint (writepipeline.BATCH_WRITE_PATH):
+            # outside every kind route, so a vanilla apiserver 404s it
+            # and the client degrades.  Handled before routing but
+            # INSIDE the APF gate below via the shared admission block —
+            # one batch holds one seat, which is the endpoint's whole
+            # point under overload.
+            if (
+                method == "post"
+                and self.serve_batch_writes
+                and urlparse(self.path).path == BATCH_WRITE_PATH
+            ):
+                self._admit_and_run({}, self._handle_batch_write)
+                return
+            # Journal long-poll (writepipeline.JOURNAL_WAIT_PATH): a
+            # held wait, so — like a watch — it is APF-exempt (it holds
+            # a thread, not a seat; seating it would let idle waiters
+            # starve real traffic under max_inflight).
+            if (
+                method == "get"
+                and self.serve_batch_writes
+                and urlparse(self.path).path == JOURNAL_WAIT_PATH
+            ):
+                self._admit_and_run(
+                    {"watch": "true"},
+                    lambda: self._handle_journal_wait(
+                        dict(parse_qsl(urlparse(self.path).query))
+                    ),
+                )
+                return
             (info, namespace, name, subresource), query = self._route()
             # Fault-injection seam (ApiServerFacade.with_faults): runs
             # AFTER routing/auth and BEFORE handling, so a test can
@@ -201,42 +243,130 @@ class _Handler(BaseHTTPRequestHandler):
             hook = getattr(self, "request_hook", None)
             if hook is not None:
                 hook(method, info, namespace, name, query)
-            # Priority-and-fairness max-in-flight: a real apiserver sheds
-            # load with 429 + Retry-After + the flow-schema header BEFORE
-            # processing.  Long-held watch streams are exempt (APF seats
-            # them once at admission, not for their whole hold).
-            apf = self.apf_state
-            gated = (
-                apf is not None
-                and self.apf_max_inflight > 0
-                and query.get("watch") != "true"
+            self._admit_and_run(
+                query,
+                lambda: getattr(self, f"_handle_{method}")(
+                    info, namespace, name, subresource, query
+                ),
             )
-            if gated:
-                with apf["lock"]:
-                    if apf["active"] >= self.apf_max_inflight:
-                        apf["rejected"] += 1
-                        self._send_overload()
-                        return
-                    apf["active"] += 1
-            try:
-                # served = authenticated AND admitted (past the APF
-                # gate) — shed/unauthorized requests must not inflate a
-                # requests/sec numerator built on this counter
-                if self.apf_state is not None:
-                    with self.apf_state["lock"]:
-                        self.apf_state["served"] += 1
-                handler = getattr(self, f"_handle_{method}")
-                handler(info, namespace, name, subresource, query)
-            finally:
-                if gated:
-                    with apf["lock"]:
-                        apf["active"] -= 1
         except ApiError as err:
             self._send_error_status(err)
         except Exception as err:  # noqa: BLE001 — server boundary
             logger.exception("facade: internal error")
             internal = ApiError(str(err))
             self._send_error_status(internal)
+
+    def _admit_and_run(self, query, fn) -> None:
+        """Priority-and-fairness max-in-flight: a real apiserver sheds
+        load with 429 + Retry-After + the flow-schema header BEFORE
+        processing.  Long-held watch streams are exempt (APF seats them
+        once at admission, not for their whole hold)."""
+        apf = self.apf_state
+        gated = (
+            apf is not None
+            and self.apf_max_inflight > 0
+            and query.get("watch") != "true"
+        )
+        if gated:
+            with apf["lock"]:
+                if apf["active"] >= self.apf_max_inflight:
+                    apf["rejected"] += 1
+                    self._send_overload()
+                    return
+                apf["active"] += 1
+        try:
+            # served = authenticated AND admitted (past the APF
+            # gate) — shed/unauthorized requests must not inflate a
+            # requests/sec numerator built on this counter
+            if self.apf_state is not None:
+                with self.apf_state["lock"]:
+                    self.apf_state["served"] += 1
+            fn()
+        finally:
+            if gated:
+                with apf["lock"]:
+                    apf["active"] -= 1
+
+    def _handle_batch_write(self) -> None:
+        """The opt-in batch endpoint: apply a list of writes in order,
+        atomically PER OBJECT (each item rides the store's own object
+        lock exactly as its standalone verb would), returning per-item
+        status — one HTTP round trip where the client would have paid
+        one per write.  A failed item never blocks later items; the
+        response is always 200 with the item-level verdicts inside,
+        like a real apiserver's Status-in-body subresources."""
+        body = self._read_body()
+        items = (body or {}).get("items")
+        if not isinstance(items, list) or not items:
+            raise BadRequestError(
+                "batch write requires a non-empty items list"
+            )
+        if len(items) > MAX_BATCH_ITEMS:
+            raise BadRequestError(
+                f"batch of {len(items)} exceeds the {MAX_BATCH_ITEMS}-item cap"
+            )
+        decoded = [decode_write_op(raw) for raw in items]
+        # one store-lock hold for the whole batch (InMemoryCluster.
+        # batch_write): per-item acquisition paid a lock handoff + a
+        # scheduler round trip per write under concurrent watch
+        # pushers — ~100x the write itself at fleet scale
+        batch = getattr(self.cluster, "batch_write", None)
+        if batch is not None:
+            applied = iter(batch([op for op, err in decoded if err is None]))
+        else:
+            applied = iter(
+                apply_write_op(self.cluster, op)
+                for op, err in decoded
+                if err is None
+            )
+        results = []
+        for op, err in decoded:
+            obj = None
+            if err is None:
+                obj, err = next(applied)
+            if err is not None:
+                results.append(
+                    {"status": err.code, "error": _status_body(err)}
+                )
+            elif obj is not None:
+                results.append({"status": 200, "object": obj})
+            else:
+                results.append({"status": 200})
+        self._send_json(
+            200,
+            {
+                "kind": "BatchWriteResult",
+                "apiVersion": BATCH_WRITE_API_VERSION,
+                "items": results,
+            },
+        )
+
+    def _handle_journal_wait(self, params: Dict[str, str]) -> None:
+        """Opt-in journal long-poll (writepipeline.JOURNAL_WAIT_PATH):
+        hold the request server-side until the store's journal advances
+        past ``seq`` (or ``timeoutSeconds`` elapses), then answer with
+        the current head — ONE round trip per wait where the vanilla
+        fallback pays a 50 ms GET poll loop per waiting drain worker.
+        Rides the store's condition variable, so the wakeup is
+        zero-latency like the in-mem path."""
+        try:
+            seq = int(params.get("seq", "0"))
+        except ValueError:
+            raise BadRequestError("seq must be an integer") from None
+        try:
+            timeout_s = float(params.get("timeoutSeconds", "1"))
+        except ValueError:
+            raise BadRequestError("timeoutSeconds must be a number") from None
+        timeout_s = max(0.0, min(timeout_s, MAX_JOURNAL_WAIT_SECONDS))
+        head = self.cluster.wait_for_seq(seq, timeout=timeout_s)
+        self._send_json(
+            200,
+            {
+                "kind": "JournalHead",
+                "apiVersion": BATCH_WRITE_API_VERSION,
+                "seq": head,
+            },
+        )
 
     def _send_overload(self) -> None:
         err = TooManyRequestsError(
@@ -649,6 +779,7 @@ class ApiServerFacade:
         max_list_page: int = 0,
         max_inflight: int = 0,
         ssl_context=None,
+        batch_writes: bool = True,
     ) -> None:
         """*ssl_context*: an ``ssl.SSLContext`` (``PROTOCOL_TLS_SERVER``)
         to serve HTTPS — envtest parity (the reference's test apiserver
@@ -690,6 +821,9 @@ class ApiServerFacade:
                 # + flow-schema header on concurrent non-watch overflow).
                 "apf_max_inflight": max_inflight,
                 "apf_state": self.apf_state,
+                # False: vanilla-apiserver parity — no batch endpoint,
+                # the client's degrade path (contract-tested).
+                "serve_batch_writes": batch_writes,
             },
         )
         server_cls = (

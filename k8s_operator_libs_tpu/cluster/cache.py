@@ -27,7 +27,7 @@ import time
 from typing import Dict, List, Optional
 
 from .client import ClusterClient, JsonObj, Key
-from .errors import ExpiredError, NotFoundError
+from .errors import BadRequestError, ExpiredError, NotFoundError
 from .inmem import json_copy
 from .selectors import parse_selector
 
@@ -69,6 +69,21 @@ class InformerCache:
         #: a one-time seeding sync).
         self.externally_fed = externally_fed
         self._lock = threading.Lock()
+        #: Signaled (notify_all) whenever the local view advances —
+        #: :meth:`wait_for_update` sleeps here so visibility pollers
+        #: wake on data instead of burning 5 ms sleep-poll ticks.
+        self._update_cond = threading.Condition(self._lock)
+        #: Monotonic apply counter (see :meth:`update_token`): lets a
+        #: waiter prove "the view has not advanced since I last checked
+        #: my predicate", closing the lost-wakeup race between a
+        #: predicate check and the wait.
+        self._version = 0
+        #: Elects the single stream pump in :meth:`wait_for_update`.
+        #: Deliberately NOT ``_refresh_serial``: the pump sleeps on the
+        #: held-event condition while holding its election, and readers
+        #: must never queue behind that sleep for their own lag-gated
+        #: refreshes.
+        self._pump_lock = threading.Lock()
         # Refresh serialization — the single-reflector rule.  Reads come
         # from many threads (drain/pod workers polling visibility), but
         # only ONE may consume the journal at a time: on HTTP backends
@@ -124,13 +139,28 @@ class InformerCache:
                 self._last_sync = time.monotonic()
                 self._seeded = True
                 self.full_syncs += 1
+                self._version += 1
+                self._update_cond.notify_all()
 
     def _refresh(self) -> None:
         """Advance the view by journal deltas; relist on expiry.
         Serialized — see ``_refresh_serial``."""
         with self._refresh_serial:
             try:
-                head = self._cluster.journal_seq()
+                # When HELD watch streams cover every cached kind, the
+                # events are already pushed into local queues and the
+                # head probe adds nothing the view could use — but over
+                # HTTP it is a round trip paid under _refresh_serial on
+                # EVERY refresh, which convoys the visibility-wait
+                # pollers (drain workers + the write-pipeline barrier)
+                # behind one serialized GET per 20 ms at fleet scale.
+                held = getattr(self._cluster, "held_watch_kinds", None)
+                need_head = not (
+                    held
+                    and self._kinds is not None
+                    and set(self._kinds) <= set(held)
+                )
+                head = self._cluster.journal_seq() if need_head else None
                 events = self._cluster.events_since(
                     self._last_seq, kind=self._kinds
                 )
@@ -189,6 +219,8 @@ class InformerCache:
             if head is not None:
                 self._last_seq = max(self._last_seq, head)
             self._last_sync = time.monotonic()
+            self._version += 1
+            self._update_cond.notify_all()
 
     def _applied_newer(self, key: Key, seq: int) -> bool:
         """True when the view already holds *key* at a revision >= *seq*
@@ -218,6 +250,93 @@ class InformerCache:
             stale = time.monotonic() - self._last_sync >= self.lag_seconds
         if stale:
             self._refresh()
+
+    # -------------------------------------------------------------- waits
+    def update_token(self) -> int:
+        """Opaque view-generation stamp for :meth:`wait_for_update`'s
+        *seen* parameter.  Capture it BEFORE checking a predicate
+        against the view; the wait then returns immediately if the view
+        advanced in between (the classic lost-wakeup window)."""
+        with self._lock:
+            return self._version
+
+    def wait_for_update(
+        self, timeout: float = 0.05, seen: Optional[int] = None
+    ) -> None:
+        """Block (≤ *timeout*) until the local view advances past the
+        *seen* generation (from :meth:`update_token`), refreshing it en
+        route.  The write-visibility wait loops
+        (NodeUpgradeStateProvider) call this between predicate checks
+        instead of ``time.sleep(poll)``: at fleet scale dozens of 5 ms
+        sleep-pollers are pure scheduler churn — and worse, the view
+        they poll only advances on lag-gated refreshes, so a wave's
+        visibility-wait tail was bounded by thread-scheduling luck, not
+        by event delivery.
+
+        Event-driven under full held-watch coverage: exactly ONE waiter
+        pumps the stream (wait for a frame, drain, apply, notify) while
+        the rest nap on the update condition until the pump's apply
+        wakes them — every waiter sleeping on the held queue directly
+        was a thundering herd: each frame woke all of them and they
+        convoyed through the refresh lock re-applying nothing.
+        Draining the queue the moment a frame lands is honest there
+        (the frame's ARRIVAL is the propagation the lag models).
+        Without held coverage the wait is a bounded nap on the update
+        condition — at most the staleness lag, never refreshing early,
+        so a lag-simulating cache keeps its modeled propagation delay
+        (and the cache-sync timeout contract) intact; the caller's next
+        predicate check drives the normal lag-gated refresh.
+
+        Spurious wakeups are fine (callers re-check their predicate)."""
+        if self.lag_seconds <= 0:
+            return  # always-fresh: reads ARE the backend, nothing to await
+        deadline = time.monotonic() + timeout
+        # Externally-fed caches never pump: journal consumption belongs
+        # to the feeder (the Controller's watch tee) — a pump's
+        # _refresh() would pop held frames the feeder will never see
+        # (the held queue is pop-once).  Waiters nap on the update
+        # condition below; the feeder's ingest advances the view.
+        wait_held = (
+            None
+            if self.externally_fed
+            else getattr(self._cluster, "wait_for_held_event", None)
+        )
+        if wait_held is not None:
+            held = getattr(self._cluster, "held_watch_kinds", None)
+            if (
+                held
+                and self._kinds is not None
+                and set(self._kinds) <= set(held)
+            ):
+                while True:
+                    with self._lock:
+                        if seen is not None and self._version != seen:
+                            return
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    if self._pump_lock.acquire(blocking=False):
+                        try:
+                            # bounded hold: a concurrent reader's
+                            # lag-gated refresh may consume the frames
+                            # this pump is waiting for — re-check the
+                            # generation at least every 20 ms
+                            if wait_held(timeout=min(remaining, 0.02)):
+                                self._refresh()
+                                return
+                        finally:
+                            self._pump_lock.release()
+                    else:
+                        with self._update_cond:
+                            if seen is not None and self._version != seen:
+                                return
+                            self._update_cond.wait(min(remaining, 0.01))
+        with self._update_cond:
+            if seen is not None and self._version != seen:
+                return
+            self._update_cond.wait(
+                min(timeout, max(self.lag_seconds, 0.001))
+            )
 
     # -------------------------------------------------------------- reads
     def _check_kind(self, kind: str) -> None:
@@ -273,11 +392,57 @@ class InformerCache:
             obj = self._snapshot.get((kind, namespace, name))
             return None if obj is None else rv_str(obj)
 
-    def list(
-        self, kind: str, namespace: Optional[str] = None, label_selector: str = ""
-    ) -> List[JsonObj]:
+    def resource_versions_of(
+        self, kind: str, names, namespace: str = ""
+    ) -> Dict[str, Optional[str]]:
+        """Bulk form of :meth:`resource_version_of`: one staleness check
+        and one lock hold for the whole name set.  The visibility settle
+        after a pipelined wave polls HUNDREDS of nodes per tick — paying
+        `_maybe_refresh`'s serial-lock round trip per name serialized
+        the reconcile thread behind the stream pump at fleet scale
+        (profiled ~1 ms/name against a lookup that costs microseconds)."""
+        from .inmem import rv_str
+
         self._check_kind(kind)
         if self.lag_seconds <= 0:
+            return {
+                name: self.resource_version_of(kind, name, namespace)
+                for name in names
+            }
+        self._maybe_refresh()
+        out: Dict[str, Optional[str]] = {}
+        with self._lock:
+            for name in names:
+                obj = self._snapshot.get((kind, namespace, name))
+                out[name] = None if obj is None else rv_str(obj)
+        return out
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: str = "",
+        field_selector: str = "",
+    ) -> List[JsonObj]:
+        """``field_selector`` mirrors the backends' one indexed form —
+        ``spec.nodeName=<node>`` on Pods (the kubelet/drain selector) —
+        so informer-backed readers (the drain plan) keep the exact call
+        shape of a live LIST."""
+        self._check_kind(kind)
+        node_name = None
+        if field_selector:
+            if kind != "Pod" or not field_selector.startswith("spec.nodeName="):
+                raise BadRequestError(
+                    f"unsupported field selector {field_selector!r} "
+                    "(only Pod spec.nodeName=<node> is indexed)"
+                )
+            node_name = field_selector.split("=", 1)[1]
+        if self.lag_seconds <= 0:
+            if field_selector:
+                return self._cluster.list(
+                    kind, namespace, label_selector,
+                    field_selector=field_selector,
+                )
             return self._cluster.list(kind, namespace, label_selector)
         self._maybe_refresh()
         match = parse_selector(label_selector)
@@ -287,6 +452,11 @@ class InformerCache:
                 if k != kind:
                     continue
                 if namespace is not None and ns != namespace:
+                    continue
+                if (
+                    node_name is not None
+                    and (obj.get("spec") or {}).get("nodeName") != node_name
+                ):
                     continue
                 labels = (obj.get("metadata") or {}).get("labels") or {}
                 if match(labels):

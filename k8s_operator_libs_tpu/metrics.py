@@ -704,6 +704,65 @@ def retire_slo_gauges() -> None:
         gauge.clear()
 
 
+# ------------------------------------------------------ write pipeline
+#: Batch-size buckets: powers of two up to the dispatcher's max_batch
+#: scale — latency buckets would be meaningless for a count metric.
+WRITE_BATCH_BUCKETS: Tuple[float, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256,
+)
+
+
+def write_queue_depth_gauge() -> Gauge:
+    """Writes queued in the async dispatcher awaiting a worker/batch.
+
+    Returns the metric OBJECT: the dispatcher binds handles once at
+    construction and updates through them — 16 worker threads funneling
+    every update through the registry's create-or-get lock measurably
+    convoyed the submit path at fleet scale."""
+    return default_registry().gauge(
+        "write_queue_depth",
+        "Writes queued in the async write dispatcher awaiting dispatch.",
+    )
+
+
+def http_inflight_writes_gauge() -> Gauge:
+    """Writes currently on the wire (claimed by a dispatcher worker,
+    response not yet read) — delta-adjusted from worker threads."""
+    return default_registry().gauge(
+        "http_inflight_writes",
+        "Writes currently in flight on the HTTP write pipeline.",
+    )
+
+
+def write_batch_size_histogram() -> Histogram:
+    """Writes carried per dispatched batch (1 = a lone write; >1 = one
+    round trip carried that many writes)."""
+    return default_registry().histogram(
+        "write_batch_size",
+        "Writes carried per dispatched batch round trip.",
+        buckets=WRITE_BATCH_BUCKETS,
+    )
+
+
+def writes_coalesced_counter() -> Counter:
+    """Same-object merge patches absorbed into an earlier queued write —
+    each one a round trip that never happened."""
+    return default_registry().counter(
+        "writes_coalesced_total",
+        "Same-object merge patches coalesced into one round trip.",
+    )
+
+
+def record_batch_endpoint_fallback() -> None:
+    """The server does not serve the batch endpoint (vanilla apiserver);
+    the client degraded to per-op writes for this process."""
+    default_registry().counter(
+        "batch_endpoint_fallbacks_total",
+        "Batch write endpoint probes that found no endpoint (client "
+        "degraded to per-op writes).",
+    ).inc()
+
+
 def record_leader_transition(event: str) -> None:
     """Leader-election lifecycle: acquired | lost | released."""
     default_registry().counter(

@@ -38,6 +38,7 @@ application decision.
 from __future__ import annotations
 
 import gc
+import sys
 from contextlib import contextmanager
 from typing import Iterator, Tuple
 
@@ -103,3 +104,40 @@ def tuned_gc(
         yield
     finally:
         restore_gc(prev, unfreeze=freeze_baseline)
+
+
+#: Interpreter thread switch interval for thread-heavy control planes.
+#: CPython's default 5 ms quantum is tuned for throughput of a few
+#: CPU-bound threads; an operator process runs DOZENS of mostly-I/O
+#: threads (held watch streams, drain/pod workers, write-dispatcher
+#: workers, an in-process test apiserver in the harnesses), and under
+#: that population a thread woken by a socket or condition variable
+#: waits out other threads' full quanta before it runs — measured on
+#: the 2-core bench container, a ~2 ms HTTP batch round trip stretched
+#: to p50 ≈ 37 ms of scheduler queueing.  1 ms cuts that ~3x; going
+#: much lower starts paying measurable context-switch overhead.
+DEFAULT_SWITCH_INTERVAL = 0.001
+
+
+def tune_scheduler(
+    switch_interval: float = DEFAULT_SWITCH_INTERVAL,
+) -> float:
+    """Apply the control-plane thread-scheduling profile; returns the
+    PREVIOUS switch interval so a caller can restore it.  Process-global
+    (like :func:`tune_gc`) — an application decision, never implicit."""
+    prev = sys.getswitchinterval()
+    sys.setswitchinterval(switch_interval)
+    return prev
+
+
+@contextmanager
+def tuned_scheduler(
+    switch_interval: float = DEFAULT_SWITCH_INTERVAL,
+) -> Iterator[None]:
+    """Context-manager form of :func:`tune_scheduler` (benchmarks wrap
+    BOTH sides of an A/B in it so the interpreter regime is identical)."""
+    prev = tune_scheduler(switch_interval)
+    try:
+        yield
+    finally:
+        sys.setswitchinterval(prev)

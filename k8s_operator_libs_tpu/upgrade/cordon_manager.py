@@ -22,10 +22,19 @@ logger = logging.getLogger(__name__)
 
 class CordonManager:
     def __init__(
-        self, cluster: ClusterClient, recorder: Optional[EventRecorder] = None
+        self,
+        cluster: ClusterClient,
+        recorder: Optional[EventRecorder] = None,
+        provider=None,
     ) -> None:
         self._cluster = cluster
         self._recorder = recorder
+        #: Optional NodeUpgradeStateProvider: when its write pipeline is
+        #: active on this thread, cordon patches ride it and coalesce
+        #: with the node's state-label patch into one round trip
+        #: (provider.submit_node_patch).  Absent/inactive → the
+        #: reference's synchronous patch below.
+        self._provider = provider
 
     def cordon(self, node: JsonObj) -> None:
         self._set_unschedulable(node, True)
@@ -37,12 +46,13 @@ class CordonManager:
         if node_is_unschedulable(node) == desired:
             return
         name = name_of(node)
+        patch = {"spec": {"unschedulable": desired}}
         with tracing.start_span(
             "cordon" if desired else "uncordon", attributes={"node": name}
         ):
-            self._cluster.patch(
-                "Node", name, {"spec": {"unschedulable": desired}}
-            )
+            submit = getattr(self._provider, "submit_node_patch", None)
+            if submit is None or not submit(name, patch):
+                self._cluster.patch("Node", name, patch)
         node.setdefault("spec", {})["unschedulable"] = desired
         log_event(
             self._recorder,

@@ -23,6 +23,7 @@ workload on the node save an orbax checkpoint before eviction begins.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -44,6 +45,7 @@ from ..cluster.objects import (
     uid_of,
 )
 from ..cluster.selectors import parse_selector
+from ..cluster.writepipeline import WriteOp, transport_batch_fn
 from . import consts, util
 from .node_upgrade_state_provider import NodeUpgradeStateProvider
 from .util import EventRecorder, StringSet, log_event
@@ -84,9 +86,61 @@ class DrainHelper:
     ``get_pods_for_deletion`` builds the plan (collecting per-pod errors),
     ``delete_or_evict_pods`` executes it and waits for termination."""
 
-    def __init__(self, cluster: ClusterClient, config: DrainHelperConfig) -> None:
+    def __init__(
+        self,
+        cluster: ClusterClient,
+        config: DrainHelperConfig,
+        reader: Optional[object] = None,
+    ) -> None:
         self._cluster = cluster
         self._config = config
+        #: Snapshot-read source for the drain PLAN (the per-node pod
+        #: list).  The informer cache when the operator runs
+        #: reads_from_cache — controller-runtime parity, and over HTTP
+        #: it turns one LIST round trip per drained node into a local
+        #: indexed read.  Writes and the deletion wait stay on the live
+        #: client either way (the wait is the correctness backstop).
+        self._reader = reader if reader is not None else cluster
+
+    def _await_cordon_visible(self, node_name: str) -> None:
+        """Causal barrier for a VIEW-based drain plan: wait (bounded)
+        until the informer view shows this node cordoned.  The view
+        applies the journal in order, so a view that contains the
+        cordon write contains every pod bound to the node BEFORE it —
+        and after it the node is unschedulable, so no new pod can bind.
+        Together that makes the lagged view's pod list complete for the
+        plan; without the barrier a pod scheduled inside the staleness
+        window could be silently skipped (never evicted, never
+        checkpointed).  No-op for live readers; falls through after the
+        bound for callers draining an uncordoned node (tests, force
+        paths), whose exposure is unchanged from a live LIST."""
+        reader = self._reader
+        if reader is self._cluster or not (
+            getattr(reader, "lag_seconds", 0) > 0
+        ):
+            return
+        wait_update = getattr(reader, "wait_for_update", None)
+        token = getattr(reader, "update_token", None)
+        deadline = time.monotonic() + max(
+            1.0, 10.0 * getattr(reader, "lag_seconds", 0)
+        )
+        while True:
+            try:
+                node = reader.get("Node", node_name)
+            except NotFoundError:
+                node = None
+            if node is not None and (node.get("spec") or {}).get(
+                "unschedulable"
+            ):
+                return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            if wait_update is not None:
+                seen = token() if callable(token) else None
+                wait_update(timeout=min(0.05, remaining), seen=seen)
+            else:
+                time.sleep(min(0.01, remaining))
 
     # ------------------------------------------------------------------ plan
     def get_pods_for_deletion(
@@ -98,9 +152,11 @@ class DrainHelper:
         selector = parse_selector(cfg.pod_selector)
         pods: List[JsonObj] = []
         errors: List[str] = []
+        self._await_cordon_visible(node_name)
         # the apiserver-side spec.nodeName fieldSelector a real drain uses,
-        # served from the store's pods-by-node index
-        node_pods = self._cluster.list(
+        # served from the store's pods-by-node index (or the informer
+        # cache's local view when the operator reads from cache)
+        node_pods = self._reader.list(
             "Pod", field_selector=f"spec.nodeName={node_name}"
         )
         for pod in node_pods:
@@ -160,6 +216,41 @@ class DrainHelper:
         to_evict = list(pods)
         while to_evict:
             blocked: List[JsonObj] = []
+            batch_fn = transport_batch_fn(self._cluster)
+            if batch_fn is not None and len(to_evict) > 1:
+                # One round trip for the whole wave of evictions/deletes
+                # (per-item status) instead of one per pod — drain-path
+                # half of the write-pipeline fix.  Semantics per item
+                # are identical to the loop below: gone already = fine,
+                # PDB 429 = retry, anything else = the drain fails.
+                verb = "delete" if self._config.disable_eviction else "evict"
+                ops = [
+                    WriteOp(
+                        op=verb,
+                        kind="Pod",
+                        name=name_of(pod),
+                        namespace=namespace_of(pod),
+                        # kubectl semantics: grace -1 = pod's own
+                        # terminationGracePeriodSeconds (store resolves)
+                        grace_period_seconds=self._config.grace_period_seconds,
+                    )
+                    for pod in to_evict
+                ]
+                try:
+                    results = batch_fn(ops)
+                except TooManyRequestsError:
+                    # whole POST shed (APF, after the client's own
+                    # Retry-After replays): nothing applied — back off
+                    # via the normal PDB retry cadence, never spray
+                    results = [(None, TooManyRequestsError("shed"))] * len(ops)
+                for pod, (_, err) in zip(to_evict, results):
+                    if err is None or isinstance(err, NotFoundError):
+                        continue
+                    if isinstance(err, TooManyRequestsError):
+                        blocked.append(pod)  # PDB budget exhausted — retry
+                    else:
+                        raise err
+                to_evict = []
             for pod in to_evict:
                 try:
                     # kubectl semantics: grace -1 = pod's own
@@ -196,15 +287,33 @@ class DrainHelper:
             # drain doesn't hammer the store lock every 10 ms
             time.sleep(0.25)
         pending = {(namespace_of(p), name_of(p)): uid_of(p) for p in pods}
+        # Termination-wait read source: the informer cache when the
+        # operator reads from cache (a deletion can only become visible
+        # there because the STORE deleted the pod — the journal never
+        # invents frames — so waiting on the view is safe: staleness
+        # waits longer, never shorter).  This is the held-stream half of
+        # the write-pipeline fix: one worker per pending pod per wake
+        # previously paid a live GET round trip, plus a journal head
+        # probe + long-poll each — the per-wave HTTP storm the informer
+        # exists to absorb.  Live-client fallback otherwise.
+        reader = self._reader
+        # Only a lag-modeling cache can be AWAITED (its wait_for_update
+        # blocks until the view advances); an always-fresh cache's wait
+        # returns immediately by contract — using it here would turn
+        # this loop into a hot spin for the whole grace period.  Fall
+        # through to the journal wait / sleep for those readers.
+        cache_wait = (
+            getattr(reader, "wait_for_update", None)
+            if getattr(reader, "lag_seconds", 0) > 0
+            else None
+        )
+        token = getattr(reader, "update_token", None) if cache_wait else None
         waiter = getattr(self._cluster, "wait_for_seq", None)
         while pending:
-            # Head BEFORE the check: a deletion landing mid-check advances
-            # the journal past `head`, so the wait below returns instantly
-            # instead of sleeping through the event.
-            head = self._cluster.journal_seq() if waiter is not None else 0
+            seen = token() if callable(token) else None
             for (ns, name), uid in list(pending.items()):
                 try:
-                    current = self._cluster.get("Pod", name, ns)
+                    current = reader.get("Pod", name, ns)
                     if uid_of(current) != uid:
                         del pending[(ns, name)]
                 except NotFoundError:
@@ -221,8 +330,18 @@ class DrainHelper:
                 if deadline is not None
                 else 1.0
             )
-            if waiter is not None:
-                # event-driven: wakes the moment ANY write lands
+            if cache_wait is not None:
+                # event-driven on the informer view (zero HTTP under
+                # held coverage); spurious wakeups re-check above
+                cache_wait(timeout=min(0.05, remaining), seen=seen)
+            elif waiter is not None:
+                # event-driven: wakes the moment ANY write lands.  Head
+                # is probed only when a wait is actually needed (pods
+                # already gone → zero probes); a deletion landing
+                # between the check above and this probe advances the
+                # journal first, so the wait degrades to one bounded
+                # timeout tick, never a missed event.
+                head = self._cluster.journal_seq()
                 waiter(head, timeout=min(1.0, remaining))
             else:
                 time.sleep(0.05)
@@ -242,12 +361,22 @@ class DrainConfiguration:
     nodes: List[JsonObj] = field(default_factory=list)
 
 
-#: Default bound on concurrent drain/eviction workers.  The reference
-#: spawns one goroutine per node (drain_manager.go:109-133) — free in Go,
-#: not in Python: a 4096-host wave must not mean 4096 threads.  Workers
+#: Ceiling on concurrent drain/eviction workers.  The reference spawns
+#: one goroutine per node (drain_manager.go:109-133) — free in Go, not
+#: in Python: a 4096-host wave must not mean 4096 threads.  Workers
 #: above the bound queue inside the executor; the StringSet dedup is
 #: unchanged.
 DEFAULT_WORKER_POOL_SIZE = 32
+
+
+def default_worker_pool_size() -> int:
+    """Drain/pod worker pool width: scales with the MACHINE, not the
+    fleet.  Every Python worker thread is GIL/scheduler pressure, and
+    the per-node work is a couple of short (often batched) round trips —
+    on a 2-core operator pod, 32 workers spend more time convoying
+    through the interpreter than overlapping I/O.  4× cores, clamped to
+    [4, DEFAULT_WORKER_POOL_SIZE]."""
+    return max(4, min(DEFAULT_WORKER_POOL_SIZE, 4 * (os.cpu_count() or 4)))
 
 
 class DrainManager:
@@ -263,21 +392,28 @@ class DrainManager:
         pre_drain_gate: Optional[PreDrainGate] = None,
         cordon_manager: Optional["CordonManager"] = None,
         pool: Optional[ThreadPoolExecutor] = None,
+        reader: Optional[object] = None,
     ) -> None:
         from .cordon_manager import CordonManager  # local: avoid import cycle
 
         self._cluster = cluster
+        self._reader = reader
         self._provider = provider
         self._recorder = recorder
         self._gate = pre_drain_gate
-        self._cordon_manager = cordon_manager or CordonManager(cluster, recorder)
+        # provider-linked: a drain worker's cordon call stays synchronous
+        # (the pipeline is thread-local and never active on workers), but
+        # a reconcile-thread cordon rides the write pipeline when open.
+        self._cordon_manager = cordon_manager or CordonManager(
+            cluster, recorder, provider=provider
+        )
         self._in_flight = StringSet()
         # Shared with PodManager when assembled by ClusterUpgradeStateManager
         # (one pool per operator, not per manager).  Threads spawn lazily,
         # so idle managers cost nothing.
         self._owns_pool = pool is None
         self._pool = pool or ThreadPoolExecutor(
-            max_workers=DEFAULT_WORKER_POOL_SIZE,
+            max_workers=default_worker_pool_size(),
             thread_name_prefix="drain-worker",
         )
 
@@ -334,7 +470,8 @@ class DrainManager:
                     self._gate.wait_for_checkpoint(node)
                 helper = DrainHelper(
                     self._cluster,
-                    DrainHelperConfig(
+                    reader=self._reader,
+                    config=DrainHelperConfig(
                         force=spec.force,
                         delete_empty_dir=spec.delete_empty_dir,
                         ignore_all_daemon_sets=True,
@@ -378,11 +515,40 @@ class DrainManager:
             self._finish(node, consts.UPGRADE_STATE_POD_RESTART_REQUIRED)
 
     def _finish(self, node: JsonObj, state: str) -> None:
+        name = name_of(node)
+
+        def _on_done(err) -> None:
+            if err is not None:
+                logger.error(
+                    "failed to update state for node %s: %s", name, err
+                )
+            self._in_flight.remove(name)
+
+        # Async when the provider can (pipelined manager over a
+        # batching transport): the worker thread is released to the
+        # next node immediately and a wave's finish writes batch into a
+        # few round trips; in_flight holds the node until the write
+        # lands (released by _on_done) so wait_idle keeps its meaning.
+        # Sync fallback preserves the reference behavior exactly.
+        async_change = getattr(
+            self._provider, "change_node_upgrade_state_async", None
+        )
+        try:
+            if async_change is not None and async_change(
+                node, state, _on_done
+            ):
+                return  # in_flight released by _on_done at completion
+        except Exception as err:  # noqa: BLE001
+            logger.error(
+                "failed to update state for node %s: %s", name, err
+            )
+            self._in_flight.remove(name)
+            return
         try:
             self._provider.change_node_upgrade_state(node, state)
         except Exception as err:  # noqa: BLE001
             logger.error(
-                "failed to update state for node %s: %s", name_of(node), err
+                "failed to update state for node %s: %s", name, err
             )
         finally:
-            self._in_flight.remove(name_of(node))
+            self._in_flight.remove(name)

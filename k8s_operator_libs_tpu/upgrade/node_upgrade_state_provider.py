@@ -18,7 +18,7 @@ import logging
 import threading
 import time
 from contextlib import contextmanager
-from typing import Iterator, List, Optional, Tuple
+from typing import Callable, Iterator, List, Optional, Tuple
 
 from .. import metrics
 from ..cluster.cache import InformerCache
@@ -46,60 +46,77 @@ class CacheSyncTimeoutError(Exception):
 
 
 class _WritePipeline:
-    """Bookkeeping for :meth:`NodeUpgradeStateProvider.pipelined_writes`:
-    in-flight patch futures plus the (node, rv) visibility obligations
-    their completions produced.  Thread-safe — futures complete on pool
-    threads while the reconcile thread drains.
+    """Bookkeeping for :meth:`NodeUpgradeStateProvider.pipelined_writes`
+    over the batched :class:`~..cluster.writepipeline.WriteDispatcher`:
+    per-write completion callbacks (worker threads) record the
+    (node, rv) visibility obligations and any failures; the reconcile
+    thread drains both at the barrier.
 
-    Same-name submissions are CHAINED: a write for node X waits for
-    X's previous in-flight write before patching, so per-node write
+    Ordering is the dispatcher's ordered-per-object contract: a node's
+    writes form a FIFO with at most one in flight, so per-node write
     order equals submit order even within one phase (some phases issue
-    a label write and an annotation write for the same node — today
-    those merge-patches touch disjoint keys, but ordering must not
-    rest on that staying true).  Deadlock-free: the executor starts
-    tasks in submit (FIFO) order, so a chained task's predecessor is
-    always already running or done when the successor starts; the
-    chain head never waits."""
+    a label write and an annotation write for the same node — those
+    usually COALESCE into one round trip; when they can't, FIFO still
+    holds).  The dispatcher also holds the provider's KeyedMutex per
+    node while a batch is on the wire, so synchronous writers (async
+    drain/eviction workers) serialize against pipelined writes exactly
+    as they do against synchronous ones."""
 
-    def __init__(self, pool) -> None:
-        self.pool = pool
+    def __init__(self, dispatcher) -> None:
+        self.dispatcher = dispatcher
         self._lock = threading.Lock()
-        self._futures: List = []
+        self._done = threading.Condition(self._lock)
+        self._submitted = 0
+        self._completed = 0
         self._rvs: List[Tuple[str, int]] = []
-        self._last_for_name: dict = {}
+        self._errors: List[BaseException] = []
 
-    def submit(self, name: str, fn) -> None:
+    def submit(self, name: str, patch: JsonObj) -> None:
+        from ..cluster.writepipeline import WriteOp
+
+        def _on_done(obj, err) -> None:
+            with self._done:
+                if err is not None:
+                    self._errors.append(err)
+                elif obj is not None:
+                    self._rvs.append((name, _rv_of(obj)))
+                self._completed += 1
+                self._done.notify_all()
+
+        # lazy: phase processors submit node-after-node with patch
+        # construction between submits, so an idle dispatcher worker
+        # claiming each write the instant it lands ships the whole
+        # phase as 1-op batches (one round trip each).  The linger
+        # gathers the submit stream into real batches; the only cost is
+        # ≤ one window at the phase barrier.
+        self.dispatcher.submit(
+            WriteOp(op="patch", kind="Node", name=name, body=patch),
+            _on_done,
+            lazy=True,
+        )
+        # counted only AFTER the dispatcher accepted it: a raising
+        # submit (dispatcher closed mid-shutdown) must not leave join()
+        # waiting forever on a completion that can never come.  Same
+        # thread as join(), so the callback racing ahead of this
+        # increment is harmless — join only reads the counters later.
         with self._lock:
-            prev = self._last_for_name.get(name)
+            self._submitted += 1
 
-            def chained() -> None:
-                if prev is not None:
-                    try:
-                        prev.result()
-                    except BaseException:  # noqa: BLE001 — prev's own
-                        pass  # future carries it to the barrier
-                fn()
-
-            fut = self.pool.submit(chained)
-            self._futures.append(fut)
-            self._last_for_name[name] = fut
-
-    def add_rv(self, name: str, rv: int) -> None:
-        with self._lock:
-            self._rvs.append((name, rv))
-
-    def drain_futures(self) -> list:
-        with self._lock:
-            futures, self._futures = self._futures, []
-            self._last_for_name.clear()
-            return futures
-
-    def drain_rvs(self) -> List[Tuple[str, int]]:
-        """Call only after the drained futures have completed — a future
-        still in flight would add its rv after the drain."""
-        with self._lock:
+    def join(self) -> Tuple[List[Tuple[str, int]], Optional[BaseException]]:
+        """Wait for every write THIS pipeline submitted (all of them
+        COMPLETE — later writes are never abandoned because an earlier
+        one failed), then hand back the visibility obligations and the
+        first failure.  Deliberately NOT a dispatcher-wide flush: the
+        dispatcher is shared with the async drain/pod workers'
+        blocking writes, and a phase barrier that drained the whole
+        queue would wait behind an unbounded stream of OTHER threads'
+        traffic."""
+        with self._done:
+            while self._completed < self._submitted:
+                self._done.wait(0.1)
             rvs, self._rvs = self._rvs, []
-            return rvs
+            errors, self._errors = self._errors, []
+        return rvs, (errors[0] if errors else None)
 
 
 class NodeUpgradeStateProvider:
@@ -113,6 +130,7 @@ class NodeUpgradeStateProvider:
         cache_sync_timeout_seconds: float = DEFAULT_CACHE_SYNC_TIMEOUT_SECONDS,
         cache_sync_poll_seconds: float = DEFAULT_CACHE_SYNC_POLL_SECONDS,
         flight_recorder: Optional["timeline_mod.FlightRecorder"] = None,
+        async_visibility: bool = False,
     ) -> None:
         self._cluster = cluster
         self._cache = cache
@@ -137,8 +155,24 @@ class NodeUpgradeStateProvider:
         # than label values keeps the wait satisfiable even when a later
         # writer (e.g. an async drain worker) overwrites the same key.
         self._local = threading.local()
-        #: Lazily created, provider-lifetime pool for pipelined_writes.
-        self._pipeline_pool = None
+        #: Lazily created, provider-lifetime write dispatcher for
+        #: pipelined_writes (batched against transports that batch).
+        self._write_dispatcher = None
+        #: Async-visibility mode (opted in by the manager alongside the
+        #: write pipeline): writes from threads with NO thread-local
+        #: defer/pipeline context — the async drain/pod workers — record
+        #: their (node, rv) obligation here instead of blocking on the
+        #: informer lag per write.  The manager settles the whole set in
+        #: one amortized wait at the top of the next BuildState
+        #: (:meth:`flush_async_visibility`), which is the exact contract
+        #: the per-write wait existed to uphold: the next reconcile
+        #: never reads state older than the workers' own transitions.
+        #: At fleet scale the per-write version was also a scheduler
+        #: storm — dozens of workers sleeping/waking against a view that
+        #: advances in batches.
+        self._async_visibility = async_visibility
+        self._async_lock = threading.Lock()
+        self._async_pending: List[Tuple[str, int]] = []
 
     # ------------------------------------------------------------- config
     def set_cache_sync_timeout(self, timeout_seconds: float) -> None:
@@ -164,6 +198,34 @@ class NodeUpgradeStateProvider:
         shared ``*corev1.Node`` the same way).
         """
         name = (node.get("metadata") or {}).get("name", "")
+        patch, mutate = self._state_patch(node, new_state)
+        if not self._submit_patch(name, patch) and not self._dispatch_blocking(
+            name, patch
+        ):
+            with self._keyed_mutex.lock(name):
+                updated = self._cluster.patch("Node", name, patch)
+                self._wait_or_defer(name, _rv_of(updated))
+        mutate()
+        metrics.record_state_transition(new_state)
+        listener = getattr(self._local, "listener", None)
+        if listener is not None:
+            listener(node, new_state)
+        log_event(
+            self._recorder,
+            name,
+            "Normal",
+            util.get_event_reason(),
+            f"Node upgrade state set to {new_state or '<unknown>'}",
+        )
+
+    def _state_patch(
+        self, node: JsonObj, new_state: str
+    ) -> Tuple[JsonObj, Callable[[], None]]:
+        """Build the state-transition merge patch shared by the sync and
+        async write paths, plus the deferred in-place mutation of the
+        caller's node dict (applied at/after submit so the caller's
+        snapshot stays coherent — the reference mutates the shared
+        ``*corev1.Node`` the same way)."""
         key = util.get_upgrade_state_label_key()
         done_stamp = None
         if new_state == consts.UPGRADE_STATE_UNKNOWN:
@@ -181,8 +243,8 @@ class NodeUpgradeStateProvider:
         # Flight-recorder checkpoint rides the SAME patch too, for the
         # same crash-split reason: the per-node phase timeline must
         # survive operator failover without a second write.  Recorded
-        # optimistically (like the in-place node mutation below); a
-        # failed patch is corrected by the next observation sweep.
+        # optimistically (like the in-place node mutation); a failed
+        # patch is corrected by the next observation sweep.
         # `is None`, not truthiness: an EMPTY injected recorder is falsy
         # (len() == 0) but still the one the caller chose
         flight = (
@@ -195,34 +257,83 @@ class NodeUpgradeStateProvider:
             patch["metadata"].setdefault("annotations", {})[
                 util.get_timeline_annotation_key()
             ] = checkpoint
-        if not self._submit_patch(name, patch):
-            with self._keyed_mutex.lock(name):
-                updated = self._cluster.patch("Node", name, patch)
-                self._wait_or_defer(name, _rv_of(updated))
-        node.setdefault("metadata", {}).setdefault("labels", {})
-        if new_state == consts.UPGRADE_STATE_UNKNOWN:
-            node["metadata"]["labels"].pop(key, None)
-        else:
-            node["metadata"]["labels"][key] = new_state
-        if done_stamp is not None:
-            node["metadata"].setdefault("annotations", {})[
-                util.get_done_at_annotation_key()
-            ] = done_stamp
-        if checkpoint is not None:
-            node["metadata"].setdefault("annotations", {})[
-                util.get_timeline_annotation_key()
-            ] = checkpoint
-        metrics.record_state_transition(new_state)
-        listener = getattr(self._local, "listener", None)
-        if listener is not None:
-            listener(node, new_state)
-        log_event(
-            self._recorder,
-            name,
-            "Normal",
-            util.get_event_reason(),
-            f"Node upgrade state set to {new_state or '<unknown>'}",
+
+        def mutate() -> None:
+            node.setdefault("metadata", {}).setdefault("labels", {})
+            if new_state == consts.UPGRADE_STATE_UNKNOWN:
+                node["metadata"]["labels"].pop(key, None)
+            else:
+                node["metadata"]["labels"][key] = new_state
+            if done_stamp is not None:
+                node["metadata"].setdefault("annotations", {})[
+                    util.get_done_at_annotation_key()
+                ] = done_stamp
+            if checkpoint is not None:
+                node["metadata"].setdefault("annotations", {})[
+                    util.get_timeline_annotation_key()
+                ] = checkpoint
+
+        return patch, mutate
+
+    def change_node_upgrade_state_async(
+        self,
+        node: JsonObj,
+        new_state: str,
+        on_done: Callable[[Optional[BaseException]], None],
+    ) -> bool:
+        """Fire-and-callback form of :meth:`change_node_upgrade_state`
+        for async workers (drain/pod pool threads): queue the same
+        label+annotation patch on the shared write dispatcher and
+        return immediately; *on_done(err)* fires from a dispatcher
+        worker once the write lands (err=None) or fails.
+
+        Only available in async-visibility mode over a batching
+        transport with a live dispatcher — returns False otherwise and
+        the caller falls back to the synchronous method.  Semantics
+        preserved vs the sync path: the visibility obligation is
+        recorded at completion (settled by the next BuildState's
+        flush), per-node ordering rides the dispatcher's keyed FIFO +
+        KeyedMutex, and the caller's node dict is updated optimistically
+        exactly like the pipelined reconcile writes.  What changes is
+        WHO waits: nobody — a wave of workers' finish writes batches
+        into a few round trips instead of each worker blocking out a
+        scheduling round trip of its own."""
+        if not self._async_visibility:
+            return False
+        dispatcher = self._write_dispatcher
+        if dispatcher is None or not getattr(
+            self._cluster, "transport_batching", False
+        ):
+            return False
+        from ..cluster.writepipeline import WriteOp
+
+        name = (node.get("metadata") or {}).get("name", "")
+        patch, mutate = self._state_patch(node, new_state)
+
+        def _on_done(obj, err) -> None:
+            if err is None:
+                with self._async_lock:
+                    self._async_pending.append((name, _rv_of(obj)))
+                metrics.record_state_transition(new_state)
+                log_event(
+                    self._recorder,
+                    name,
+                    "Normal",
+                    util.get_event_reason(),
+                    f"Node upgrade state set to {new_state or '<unknown>'}",
+                )
+            try:
+                on_done(err)
+            except Exception:  # noqa: BLE001 — callback boundary
+                logger.exception("async state-change callback failed")
+
+        mutate()
+        dispatcher.submit(
+            WriteOp(op="patch", kind="Node", name=name, body=patch),
+            _on_done,
+            lazy=True,
         )
+        return True
 
     def change_node_upgrade_annotation(
         self, node: JsonObj, key: str, value: str
@@ -237,7 +348,9 @@ class NodeUpgradeStateProvider:
         delete = value == consts.NULL_STRING
         patch_value = None if delete else value
         patch = {"metadata": {"annotations": {key: patch_value}}}
-        if not self._submit_patch(name, patch):
+        if not self._submit_patch(name, patch) and not self._dispatch_blocking(
+            name, patch
+        ):
             with self._keyed_mutex.lock(name):
                 updated = self._cluster.patch("Node", name, patch)
                 self._wait_or_defer(name, _rv_of(updated))
@@ -266,12 +379,16 @@ class NodeUpgradeStateProvider:
 
         Correctness contract:
 
-        * :meth:`pipeline_barrier` MUST be called between phases: it
-          joins every in-flight patch (re-raising the first failure) and
-          converts their visibility obligations into this thread's
-          normal wait-or-defer flow.  Per-node write ORDER is preserved
-          everywhere: across phases by the barrier, within a phase by
-          per-name chaining in the pipeline (see :class:`_WritePipeline`).
+        * :meth:`pipeline_barrier` joins every in-flight patch
+          (re-raising the first failure) and converts their visibility
+          obligations into this thread's normal wait-or-defer flow; the
+          block exit runs it, and ApplyState runs ONE per pass.  Per-
+          node write ORDER needs no barrier at all: across AND within
+          phases it is the dispatcher's ordered-per-object FIFO (see
+          :class:`_WritePipeline`), and a node's still-queued earlier
+          patch composing with its later one is the coalescing idiom
+          (soundness checked per pair; non-composable pairs ship
+          separately, in order).
         * Thread-local, like :meth:`deferred_visibility`: async
           drain/eviction workers writing through this provider remain
           fully synchronous.
@@ -282,10 +399,10 @@ class NodeUpgradeStateProvider:
           crash mid-pass loses nothing), and the next BuildState
           re-derives truth from the cluster.
 
-        The pool is provider-lifetime (created on first use, resized
-        never — the first caller's *max_workers* wins) so a per-second
-        reconcile cadence doesn't pay thread spawn/join per pass;
-        :meth:`close` releases it for short-lived embedders.
+        The dispatcher is provider-lifetime (created on first use,
+        resized never — the first caller's *max_workers* wins) so a
+        per-second reconcile cadence doesn't pay thread spawn/join per
+        pass; :meth:`close` releases it for short-lived embedders.
 
         Reference contrast: the reference has no analog (every write is
         sequential and individually visibility-waited,
@@ -296,15 +413,35 @@ class NodeUpgradeStateProvider:
         if getattr(self._local, "pipeline", None) is not None:
             yield  # nested: the outer block owns the pipeline
             return
-        pool = self._pipeline_pool
-        if pool is None:
-            from concurrent.futures import ThreadPoolExecutor
+        dispatcher = self._write_dispatcher
+        if dispatcher is None:
+            from ..cluster.writepipeline import WriteDispatcher
 
-            pool = ThreadPoolExecutor(
-                max_workers=max_workers, thread_name_prefix="node-write"
+            # Transport-level batching only where batch_write saves a
+            # round trip (KubeApiClient → the facade's batch endpoint,
+            # degrading transparently against a vanilla apiserver).
+            # Over the in-memory store a batch saves nothing, so per-op
+            # mode (max_batch=1) keeps concurrency at the worker level
+            # and preserves per-verb error fidelity for test fakes.
+            batching = getattr(self._cluster, "transport_batching", False)
+            dispatcher = WriteDispatcher(
+                self._cluster,
+                # batch transport: a few fat batches beat many thin
+                # streams — and every extra worker thread is a GIL/lock
+                # convoy tax on the submit path at fleet scale
+                max_workers=min(max_workers, 4) if batching else max_workers,
+                max_batch=64 if batching else 1,
+                mutex=self._keyed_mutex,
+                mutex_key=lambda op: op.name or None,
+                use_batch=batching,
+                # lazy-entry linger only (see _Entry.lazy): worker
+                # writes trickling in one per worker gather ~5 ms into
+                # one batch round trip; the reconcile pipeline's burst
+                # writes are eager and never pay it
+                coalesce_window_s=0.015 if batching else 0.0,
             )
-            self._pipeline_pool = pool
-        pipe = _WritePipeline(pool)
+            self._write_dispatcher = dispatcher
+        pipe = _WritePipeline(dispatcher)
         self._local.pipeline = pipe
         try:
             yield
@@ -316,19 +453,15 @@ class NodeUpgradeStateProvider:
             # queued write landing DURING the next reconcile could
             # overwrite that pass's fresh write and regress a node's
             # state (KeyedMutex serializes, it does not order)
-            for fut in pipe.drain_futures():
-                try:
-                    fut.result()
-                except BaseException:  # noqa: BLE001 — body error wins
-                    pass
-            pipe.drain_rvs()
+            pipe.join()
 
     def close(self) -> None:
-        """Release the pipeline worker pool (short-lived embedders; a
-        long-lived operator's pool lives as long as the process)."""
-        pool, self._pipeline_pool = self._pipeline_pool, None
-        if pool is not None:
-            pool.shutdown(wait=True)
+        """Release the write dispatcher's workers (short-lived embedders;
+        a long-lived operator's dispatcher lives as long as the
+        process)."""
+        dispatcher, self._write_dispatcher = self._write_dispatcher, None
+        if dispatcher is not None:
+            dispatcher.close()
 
     def pipeline_barrier(self) -> None:
         """Join every in-flight pipelined write from this thread: block
@@ -339,15 +472,9 @@ class NodeUpgradeStateProvider:
         pipe = getattr(self._local, "pipeline", None)
         if pipe is None:
             return
-        first_err: Optional[BaseException] = None
-        for fut in pipe.drain_futures():
-            try:
-                fut.result()
-            except BaseException as err:  # noqa: BLE001 — collected, re-raised
-                if first_err is None:
-                    first_err = err
+        rvs, first_err = pipe.join()
         try:
-            for name, rv in pipe.drain_rvs():
+            for name, rv in rvs:
                 self._wait_or_defer(name, rv)
         except Exception as err:  # noqa: BLE001 — see below
             # a cache-lag timeout while settling the waits must not MASK
@@ -357,20 +484,70 @@ class NodeUpgradeStateProvider:
         if first_err is not None:
             raise first_err
 
+    def submit_node_patch(self, name: str, patch: JsonObj) -> bool:
+        """Queue an arbitrary node merge patch on this thread's active
+        write pipeline; returns False when not pipelining (the caller
+        then writes synchronously).  Other node-writers — the cordon
+        manager's ``spec.unschedulable`` flips — ride the same
+        dispatcher as the state-label writes, so a phase's cordon patch
+        COALESCES with the node's state-label patch into one round trip
+        (and shares the per-node FIFO + KeyedMutex ordering contract).
+        Failures surface at the phase barrier like every pipelined
+        write."""
+        return self._submit_patch(name, patch)
+
     def _submit_patch(self, name: str, patch: JsonObj) -> bool:
-        """Pipelined-mode write path: queue the locked patch + rv
-        bookkeeping on the pool; returns False when not pipelining (the
-        caller then writes synchronously)."""
+        """Pipelined-mode write path: queue the patch on the write
+        dispatcher (which holds this provider's KeyedMutex per node
+        while the write is on the wire, coalesces same-node merge
+        patches into one round trip, and ships batches through the
+        transport's batch endpoint when it has one); returns False when
+        not pipelining (the caller then writes synchronously)."""
         pipe = getattr(self._local, "pipeline", None)
         if pipe is None:
             return False
+        pipe.submit(name, patch)
+        return True
 
-        def _do() -> None:
-            with self._keyed_mutex.lock(name):
-                updated = self._cluster.patch("Node", name, patch)
-            pipe.add_rv(name, _rv_of(updated))
+    def _dispatch_blocking(self, name: str, patch: JsonObj) -> bool:
+        """Worker-thread write path over a BATCHING transport: ride the
+        shared dispatcher and block for the result, so N concurrent
+        drain/eviction workers' node writes share one batch round trip
+        instead of paying one HTTP round trip each (while the reconcile
+        thread's own pipeline stays thread-local and unordered relative
+        to nothing — the dispatcher's per-key FIFO and KeyedMutex hold
+        for both).  The blocking wait preserves each worker's program
+        order exactly like the synchronous path; the visibility wait
+        runs after the write lands, as before.  Returns False when
+        there is no dispatcher yet or the transport doesn't batch (the
+        in-memory store: a per-op dispatcher hop would only add
+        overhead and bypass per-verb test fakes)."""
+        dispatcher = self._write_dispatcher
+        if dispatcher is None or not getattr(
+            self._cluster, "transport_batching", False
+        ):
+            return False
+        from ..cluster.writepipeline import WriteOp
 
-        pipe.submit(name, _do)
+        done = threading.Event()
+        box: list = []
+
+        def _on_done(obj, err) -> None:
+            box.append((obj, err))
+            done.set()
+
+        # lazy: the ~5 ms linger lets concurrent workers' writes share
+        # one batch round trip — far cheaper than each paying its own
+        dispatcher.submit(
+            WriteOp(op="patch", kind="Node", name=name, body=patch),
+            _on_done,
+            lazy=True,
+        )
+        done.wait()
+        obj, err = box[0]
+        if err is not None:
+            raise err
+        self._wait_or_defer(name, _rv_of(obj))
         return True
 
     # ------------------------------------------------- transition listener
@@ -428,6 +605,18 @@ class NodeUpgradeStateProvider:
         by this thread."""
         pending: List[Tuple[str, int]] = getattr(self._local, "pending", [])
         self._local.pending = []
+        self._wait_all_visible(pending)
+
+    def flush_async_visibility(self) -> None:
+        """Settle every async-visibility obligation (worker-thread writes
+        recorded instead of waited — see ``async_visibility``).  The
+        manager calls this at the top of BuildState so the snapshot it
+        is about to take includes all of them."""
+        with self._async_lock:
+            pending, self._async_pending = self._async_pending, []
+        self._wait_all_visible(pending)
+
+    def _wait_all_visible(self, pending: List[Tuple[str, int]]) -> None:
         if not pending:
             return
         # Only the newest awaited RV per node matters.
@@ -435,10 +624,30 @@ class NodeUpgradeStateProvider:
         for name, rv in pending:
             wanted[name] = max(rv, wanted.get(name, 0))
         deadline = time.monotonic() + self._timeout
+        # Bulk rv probe when the cache offers one: a wave's settle polls
+        # hundreds of nodes per tick, and per-name probes each pay the
+        # cache's staleness-check/lock round trip (profiled as the top
+        # HTTP-path cost once writes themselves were batched).
+        peek_many = (
+            getattr(self._cache, "resource_versions_of", None)
+            if not getattr(self._cache, "always_fresh", False)
+            else None
+        )
         while wanted:
-            for name, rv in list(wanted.items()):
-                if self._cache_caught_up(name, rv):
-                    del wanted[name]
+            seen = self._cache_update_token()
+            if peek_many is not None:
+                rvs = peek_many("Node", list(wanted))
+                for name, rv in list(wanted.items()):
+                    cached_rv = rvs.get(name)
+                    try:
+                        if cached_rv is not None and int(cached_rv) >= rv:
+                            del wanted[name]
+                    except (TypeError, ValueError):
+                        pass
+            else:
+                for name, rv in list(wanted.items()):
+                    if self._cache_caught_up(name, rv):
+                        del wanted[name]
             if not wanted:
                 return
             if time.monotonic() >= deadline:
@@ -446,11 +655,18 @@ class NodeUpgradeStateProvider:
                     "writes to nodes not visible in cache after "
                     f"{self._timeout}s: {sorted(wanted)}"
                 )
-            time.sleep(self._poll)
+            self._await_cache_tick(deadline, seen)
 
     def _wait_or_defer(self, name: str, rv: int) -> None:
         if self._defer_active():
             self._local.pending.append((name, rv))
+            return
+        if self._async_visibility:
+            # Worker-thread write under the pipelined manager: record
+            # the obligation; the next BuildState settles it (one
+            # amortized informer-lag wait for the whole wave).
+            with self._async_lock:
+                self._async_pending.append((name, rv))
             return
         self._wait_visible(name, rv)
 
@@ -485,6 +701,7 @@ class NodeUpgradeStateProvider:
     def _wait_visible(self, name: str, rv: int) -> None:
         deadline = time.monotonic() + self._timeout
         while True:
+            seen = self._cache_update_token()
             if self._cache_caught_up(name, rv):
                 return
             if time.monotonic() >= deadline:
@@ -492,4 +709,29 @@ class NodeUpgradeStateProvider:
                     f"write to node {name} not visible in cache after "
                     f"{self._timeout}s"
                 )
+            self._await_cache_tick(deadline, seen)
+
+    def _cache_update_token(self):
+        """The cache's view-generation stamp (None without support).
+        Captured BEFORE each predicate check so the event-driven wait
+        can detect "view advanced between my check and my wait" and
+        return immediately instead of blocking out its full timeout."""
+        token = getattr(self._cache, "update_token", None)
+        return token() if callable(token) else None
+
+    def _await_cache_tick(self, deadline: float, seen=None) -> None:
+        """One wait-loop tick: sleep on the cache's update signal when it
+        has one (event-driven — wakes the moment frames land, instead of
+        N workers burning 5 ms sleep-polls against a view that only
+        advances on lag-gated refreshes), else the configured poll nap."""
+        waiter = getattr(self._cache, "wait_for_update", None)
+        if waiter is not None:
+            waiter(
+                timeout=max(
+                    self._poll,
+                    min(0.05, deadline - time.monotonic()),
+                ),
+                seen=seen,
+            )
+        else:
             time.sleep(self._poll)

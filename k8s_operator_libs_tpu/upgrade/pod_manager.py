@@ -36,6 +36,7 @@ from ..cluster.objects import (
     namespace_of,
     pod_phase,
 )
+from ..cluster.writepipeline import WriteOp, transport_batch_fn
 from ..obs import tracing
 from . import consts, util
 from .drain_manager import DrainHelper, DrainHelperConfig
@@ -73,7 +74,7 @@ class PodManager:
         pool: Optional[ThreadPoolExecutor] = None,
         revision_reader=None,
     ) -> None:
-        from .drain_manager import DEFAULT_WORKER_POOL_SIZE
+        from .drain_manager import default_worker_pool_size
 
         self._cluster = cluster
         #: ControllerRevision reads for the revision-hash oracle — an
@@ -92,7 +93,7 @@ class PodManager:
         # pod-deletion wave here queues on a few dozen threads instead.
         self._owns_pool = pool is None
         self._pool = pool or ThreadPoolExecutor(
-            max_workers=DEFAULT_WORKER_POOL_SIZE,
+            max_workers=default_worker_pool_size(),
             thread_name_prefix="pod-worker",
         )
         # Completion checks are short API reads gathered synchronously by
@@ -323,6 +324,33 @@ class PodManager:
         with tracing.start_span(
             "pod-restart", attributes={"pods": len(pods)}
         ):
+            batch_fn = transport_batch_fn(self._cluster)
+            if batch_fn is not None and len(pods) > 1:
+                # One round trip deletes the whole wave's driver pods
+                # (per-item status; the DaemonSet controller recreates
+                # them) — same contract as the loop below: already-gone
+                # pods are fine, the first real failure aborts.
+                ops = [
+                    WriteOp(
+                        op="delete",
+                        kind="Pod",
+                        name=name_of(pod),
+                        namespace=namespace_of(pod),
+                    )
+                    for pod in pods
+                ]
+                for pod, (_, err) in zip(pods, batch_fn(ops)):
+                    if err is None or isinstance(err, NotFoundError):
+                        continue
+                    log_event(
+                        self._recorder,
+                        name_of(pod),
+                        "Warning",
+                        util.get_event_reason(),
+                        f"Failed to restart driver pod {err}",
+                    )
+                    raise err
+                return
             for pod in pods:
                 try:
                     self._cluster.delete(

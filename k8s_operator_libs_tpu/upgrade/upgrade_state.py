@@ -120,8 +120,15 @@ class ClusterUpgradeStateManager:
             cache_sync_timeout_seconds=cache_sync_timeout_seconds,
             cache_sync_poll_seconds=cache_sync_poll_seconds,
             flight_recorder=flight_recorder,
+            # Pipelined manager: worker-thread writes defer their
+            # visibility waits to the pre-BuildState flush instead of
+            # each paying the informer lag (the sequential-baseline
+            # manager keeps the reference's per-write wait).
+            async_visibility=write_pipeline_workers > 0,
         )
-        self._cordon_manager = cordon_manager or CordonManager(cluster, recorder)
+        self._cordon_manager = cordon_manager or CordonManager(
+            cluster, recorder, provider=self._provider
+        )
         # One bounded worker pool per operator, shared by the drain and pod
         # managers (the reference's per-node goroutines, capped — see
         # DEFAULT_WORKER_POOL_SIZE in drain_manager.py).
@@ -129,10 +136,10 @@ class ClusterUpgradeStateManager:
         if drain_manager is None or pod_manager is None:
             from concurrent.futures import ThreadPoolExecutor
 
-            from .drain_manager import DEFAULT_WORKER_POOL_SIZE
+            from .drain_manager import default_worker_pool_size
 
             shared_pool = ThreadPoolExecutor(
-                max_workers=DEFAULT_WORKER_POOL_SIZE,
+                max_workers=default_worker_pool_size(),
                 thread_name_prefix="upgrade-worker",
             )
         self._owned_pool = shared_pool
@@ -143,6 +150,7 @@ class ClusterUpgradeStateManager:
             recorder,
             pre_drain_gate=pre_drain_gate,
             pool=shared_pool,
+            reader=self._reader if reads_from_cache else None,
         )
         if drain_manager is None:
             self._owned_managers.append(self._drain_manager)
@@ -344,6 +352,15 @@ class ClusterUpgradeStateManager:
         from-scratch, or assembled O(changed) from the journal-driven
         :class:`~.state_index.ClusterStateIndex` when enabled."""
         started = time.monotonic()
+        # Settle async-visibility debt FIRST: drain/pod worker writes
+        # defer their cache-visibility waits (one amortized flush here
+        # instead of one informer-lag wait per worker write), and the
+        # flush-before-snapshot is exactly the contract those per-write
+        # waits existed to uphold — this reconcile must not read state
+        # older than the workers' own transitions.
+        flush_async = getattr(self._provider, "flush_async_visibility", None)
+        if flush_async is not None:
+            flush_async()
         index = self._index_for(namespace, driver_labels)
         # mutable: the indexed path downgrades to "full" when its
         # internal-error fallback ends up serving a full rebuild — the
@@ -810,29 +827,28 @@ class ClusterUpgradeStateManager:
             if self._deferred_visibility
             else nullcontext()
         )
-        # Phase patches overlap over the write pipeline when configured;
-        # the per-phase barrier below is its correctness contract (a
-        # node's phase-N write lands before its phase-N+1 write
-        # submits).  Both calls are gated on the flag so an injected
-        # duck-typed provider without the pipeline surface keeps
-        # working at the default (sequential) setting.
+        # Phase patches overlap over the write pipeline when configured.
+        # ONE barrier per pass (the pipelined_writes context exit), not
+        # one per phase: per-node cross-phase write order is already the
+        # dispatcher's per-key FIFO contract, and a node's still-queued
+        # phase-N patch composing with its phase-N+1 patch is the
+        # coalescing idiom itself (composition soundness is checked per
+        # pair; non-composable follow-ups ship separately, in order).
+        # Errors surface at the pass barrier — the pipeline's documented
+        # "deliberately late" failure envelope.  Gated on the flag so an
+        # injected duck-typed provider without the pipeline surface
+        # keeps working at the default (sequential) setting.
         pipelining = self._write_pipeline_workers > 0
         pipeline = (
             self._provider.pipelined_writes(self._write_pipeline_workers)
             if pipelining
             else nullcontext()
         )
-
-        def _phase_join() -> None:
-            if pipelining:
-                self._provider.pipeline_barrier()
-
         with barrier, pipeline:
             if not self._cascade:
                 with self._provider.transition_listener(_count):
                     for phase in phases:
                         phase()
-                        _phase_join()
             else:
                 # Pipelined reconcile: a state write migrates the node into
                 # its new bucket *between* phases, so one pass carries a
@@ -860,7 +876,10 @@ class ClusterUpgradeStateManager:
                 with self._provider.transition_listener(_record):
                     for phase in phases:
                         phase()
-                        _phase_join()
+                        # moves are recorded at SUBMIT time (the
+                        # listener fires with the optimistic node
+                        # mutation), so bucket migration needs no
+                        # write-completion barrier
                         self._migrate_buckets(state, moves, index)
         self.last_apply_transitions = transitions["n"]
 

@@ -102,6 +102,26 @@ class KeyedMutex:
         finally:
             lk.release()
 
+    @contextmanager
+    def lock_many(self, keys) -> Iterator[None]:
+        """Hold several keys' locks at once — acquired in SORTED key
+        order so concurrent multi-key holders can never deadlock each
+        other (and single-key holders can never close a cycle).  Used by
+        the batched write dispatcher
+        (:class:`~..cluster.writepipeline.WriteDispatcher`) to serialize
+        a whole batch against the per-node synchronous writers."""
+        ordered = sorted(set(keys))
+        held = []
+        try:
+            for key in ordered:
+                lk = self._get(key)
+                lk.acquire()
+                held.append(lk)
+            yield
+        finally:
+            for lk in reversed(held):
+                lk.release()
+
 
 # --------------------------------------------------------------------------
 # Component-name global + key builders (reference C13 half)

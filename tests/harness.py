@@ -217,20 +217,39 @@ class Fleet:
         ControllerRevision) revision; returns the number of pods
         created.  Pods of a revision listed in :attr:`bad_revisions`
         come up failing (not ready, restartCount 11)."""
+        from k8s_operator_libs_tpu.cluster.writepipeline import (
+            WriteOp,
+            transport_batch_fn,
+        )
+
         self._refresh_revision()
         covered = self._covered_nodes()
-        created = 0
-        for name in sorted(self.managed_nodes - covered):
-            # old-semantics guard: a managed node deleted from the
-            # cluster gets no pod (the relist version iterated live
-            # Node objects); the uncovered set is small, so a per-name
-            # GET costs nothing at scale
-            try:
-                self.cluster.get("Node", name)
-            except NotFoundError:
-                continue
-            bad = self.revision_hash in self.bad_revisions
-            pod = make_pod(
+        uncovered = sorted(self.managed_nodes - covered)
+        # old-semantics guard: a managed node deleted from the cluster
+        # gets no pod (the relist version iterated live Node objects).
+        # A handful of uncovered nodes → per-name GETs; a whole wave's
+        # worth → one LIST beats hundreds of round trips over HTTP.
+        if len(uncovered) > 16:
+            live = {
+                (n.get("metadata") or {}).get("name")
+                for n in self.cluster.list("Node")
+            }
+
+            def node_exists(name: str) -> bool:
+                return name in live
+
+        else:
+
+            def node_exists(name: str) -> bool:
+                try:
+                    self.cluster.get("Node", name)
+                    return True
+                except NotFoundError:
+                    return False
+
+        bad = self.revision_hash in self.bad_revisions
+        pods = [
+            make_pod(
                 f"tpu-runtime-{next(self._pod_seq)}",
                 NAMESPACE,
                 name,
@@ -240,15 +259,30 @@ class Fleet:
                 ready=not bad,
                 restart_count=11 if bad else 0,
             )
-            self.cluster.create(pod)
-            if self._covered_pods is not None:
+            for name in uncovered
+            if node_exists(name)
+        ]
+        # one round trip creates the wave's pods where the transport
+        # batches (the real DS controller's work API-side is equally
+        # few round trips via its informer-fed expectations machinery)
+        batch_fn = transport_batch_fn(self.cluster)
+        if batch_fn is not None and len(pods) > 1:
+            for _, err in batch_fn(
+                [WriteOp(op="create", kind="Pod", body=pod) for pod in pods]
+            ):
+                if err is not None:
+                    raise err
+        else:
+            for pod in pods:
+                self.cluster.create(pod)
+        if self._covered_pods is not None:
+            for pod in pods:
                 # keep the informer state current within this cycle; the
                 # journal will replay the same add idempotently
-                self._covered_pods.setdefault(name, set()).add(
-                    pod["metadata"]["name"]
-                )
-            created += 1
-        return created
+                self._covered_pods.setdefault(
+                    pod["spec"]["nodeName"], set()
+                ).add(pod["metadata"]["name"])
+        return len(pods)
 
     # ------------------------------------------------------------- queries
     def node_state(self, name: str) -> str:

@@ -782,7 +782,8 @@ class TestTransportRetryPolicy:
     connection dropped after delivery would double-create/double-evict."""
 
     def _flaky(self, client, exc, times=1):
-        orig = client._conn
+        pool = client._pool
+        orig_acquire = pool.acquire
         state = {"fail": times}
 
         class Flaky:
@@ -798,7 +799,13 @@ class TestTransportRetryPolicy:
             def __getattr__(self, name):
                 return getattr(self.inner, name)
 
-        client._conn = lambda: Flaky(orig())
+        def acquire():
+            pc = orig_acquire()
+            if not isinstance(pc.conn, Flaky):
+                pc.conn = Flaky(pc.conn)
+            return pc
+
+        pool.acquire = acquire
         return state
 
     def test_get_replayed_after_connection_reset(self):
@@ -3141,6 +3148,12 @@ class TestOverloadedThrottledRollout:
                 client,
                 cache_sync_timeout_seconds=5.0,
                 cache_sync_poll_seconds=0.01,
+                # the production HTTP config: node writes ride the async
+                # batched dispatcher, so this soak proves the PIPELINED
+                # client drains-and-retries under APF shedding instead
+                # of amplifying the brownout (the dispatcher queues and
+                # backs off; it never multiplies the request rate)
+                write_pipeline_workers=8,
             )
             policy = UpgradePolicySpec(
                 auto_upgrade=True,
@@ -3193,6 +3206,22 @@ class TestOverloadedThrottledRollout:
             "the hammer never got replayed 429s"
         )
         assert client.throttle_waited_seconds > 0, "throttle never engaged"
+        # ...and the pipelined write path respected the backpressure:
+        # the dispatcher was actually used (batching transport), and it
+        # ended the rollout fully drained — queued writes were retried
+        # to completion through the 429s, not abandoned or left queued
+        # (qps accounting: every batched POST still rides the same
+        # throttled client, so pipelined writes consume qps tokens like
+        # sequential ones — batching shrinks the request count, it
+        # never bypasses the bucket)
+        dispatcher = manager._provider._write_dispatcher
+        assert dispatcher is not None, "write pipeline never engaged"
+        assert dispatcher._batch_fn is not None, (
+            "facade transport should run the dispatcher in batch mode"
+        )
+        assert dispatcher.queue_depth == 0, (
+            "dispatcher finished the rollout with writes still queued"
+        )
 
 
 class TestEarlyRejectionBodyDrain:

@@ -362,6 +362,48 @@ class TestWatchAndLeaderMetrics:
         assert 'leader_transitions_total{event="released"} 1' in out
 
 
+class TestWritePipelineMetrics:
+    def test_dispatcher_exposes_pipeline_family(self, fresh_registry):
+        """A real dispatcher run lands `write_queue_depth`,
+        `http_inflight_writes` and `write_batch_size` in the /metrics
+        exposition — the wiring, not just the registry helpers."""
+        from k8s_operator_libs_tpu.cluster.inmem import InMemoryCluster
+        from k8s_operator_libs_tpu.cluster.writepipeline import (
+            WriteDispatcher,
+            WriteOp,
+        )
+
+        store = InMemoryCluster()
+        store.create(
+            {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n0"}}
+        )
+        dispatcher = WriteDispatcher(store, max_workers=2, use_batch=False)
+        try:
+            dispatcher.submit(
+                WriteOp(
+                    op="patch",
+                    kind="Node",
+                    name="n0",
+                    body={"metadata": {"labels": {"k": "v"}}},
+                )
+            )
+            dispatcher.flush()
+        finally:
+            dispatcher.close()
+        out = fresh_registry.render()
+        for family in (
+            "k8s_operator_libs_tpu_write_queue_depth",
+            "k8s_operator_libs_tpu_http_inflight_writes",
+            "k8s_operator_libs_tpu_write_batch_size",
+            "k8s_operator_libs_tpu_writes_coalesced_total",
+        ):
+            assert family in out, f"{family} missing from exposition"
+        # the lone write rode exactly one batch of size 1
+        assert (
+            'k8s_operator_libs_tpu_write_batch_size_bucket{le="1"} 1' in out
+        )
+
+
 class TestAlertRulesStayInSync:
     def test_alert_rule_metrics_exist_in_exposition(self):
         """hack/observability/alerts.yaml references real metric names —
@@ -393,6 +435,11 @@ class TestAlertRulesStayInSync:
                 set(),
             )
             m.record_slo_breach("drainP99Seconds")
+            # write-pipeline family (async batched write dispatcher)
+            m.write_queue_depth_gauge().set(0)
+            m.http_inflight_writes_gauge().set(0)
+            m.write_batch_size_histogram().observe(1)
+            m.writes_coalesced_counter().inc(amount=0)
             exposition = registry.render()
         finally:
             m.set_default_registry(prev)

@@ -9,7 +9,11 @@ import json
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed in this environment"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from k8s_operator_libs_tpu.api import IntOrString
 from k8s_operator_libs_tpu.cluster.inmem import json_copy, merge_patch

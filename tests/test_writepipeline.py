@@ -1,0 +1,726 @@
+"""Async batched write pipeline (cluster/writepipeline.py).
+
+Covers the ISSUE-6 contracts:
+
+* merge-patch composition soundness (RFC 7386) — composable pairs
+  produce the sequential result, non-composable pairs stay separate;
+* the randomized ordered-per-object property: concurrent submitters
+  over overlapping objects, dispatcher at max concurrency, batch and
+  per-op transports — per-object application order must equal submit
+  order, a key never has two writes in flight, and nothing deadlocks;
+* KeyedMutex interop — a synchronous writer holding a node's lock
+  blocks the dispatched batch carrying that node;
+* coalescing — same-object merge patches collapse into one round trip
+  and both callbacks see the merged write's single result;
+* 429 drain-and-retry — the dispatcher backs off and re-sends instead
+  of failing (or amplifying) on overload, in both transports;
+* the batch endpoint HTTP contract — per-item status over one POST,
+  and the transparent per-op degrade against a server without the
+  endpoint;
+* serial/pipelined rollout equivalence — the acceptance criterion that
+  a pipelined rollout converges to the same final cluster state as the
+  serial client on the same seed.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from collections import defaultdict
+
+import pytest
+
+from k8s_operator_libs_tpu import metrics
+from k8s_operator_libs_tpu.cluster import InMemoryCluster
+from k8s_operator_libs_tpu.cluster.errors import (
+    ApiError,
+    NotFoundError,
+    TooManyRequestsError,
+)
+from k8s_operator_libs_tpu.cluster.writepipeline import (
+    WriteDispatcher,
+    WriteOp,
+    apply_write_op,
+    try_compose_merge_patch,
+)
+from k8s_operator_libs_tpu.upgrade.util import KeyedMutex
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    registry = metrics.MetricsRegistry()
+    previous = metrics.set_default_registry(registry)
+    yield registry
+    metrics.set_default_registry(previous)
+
+
+# ---------------------------------------------------------------- composition
+class TestMergePatchComposition:
+    def test_leaves_overwrite_and_subobjects_merge(self):
+        first = {"metadata": {"labels": {"a": "1"}, "annotations": {"x": "1"}}}
+        second = {"metadata": {"labels": {"a": "2", "b": "3"}}}
+        composed = try_compose_merge_patch(first, second)
+        assert composed == {
+            "metadata": {
+                "labels": {"a": "2", "b": "3"},
+                "annotations": {"x": "1"},
+            }
+        }
+
+    def test_composition_equals_sequential_application(self):
+        """The definitional property, checked against a real store: for
+        composable pairs, one composed patch must leave the object
+        exactly where patch-then-patch would."""
+        rng = random.Random(7)
+        keys = ("a", "b", "c")
+
+        def rand_patch():
+            return {
+                "metadata": {
+                    "labels": {
+                        k: str(rng.randint(0, 3))
+                        for k in rng.sample(keys, rng.randint(1, 3))
+                    }
+                }
+            }
+
+        for _ in range(50):
+            first, second = rand_patch(), rand_patch()
+            composed = try_compose_merge_patch(first, second)
+            assert composed is not None
+            sequential = InMemoryCluster()
+            sequential.create({"kind": "Node", "metadata": {"name": "n"}})
+            sequential.patch("Node", "n", first)
+            seq_obj = sequential.patch("Node", "n", second)
+            oneshot = InMemoryCluster()
+            oneshot.create({"kind": "Node", "metadata": {"name": "n"}})
+            one_obj = oneshot.patch("Node", "n", composed)
+            assert seq_obj["metadata"]["labels"] == one_obj["metadata"]["labels"]
+
+    def test_null_deletion_overwrites(self):
+        composed = try_compose_merge_patch(
+            {"metadata": {"labels": {"a": "1"}}},
+            {"metadata": {"labels": {"a": None}}},
+        )
+        assert composed == {"metadata": {"labels": {"a": None}}}
+
+    def test_subobject_over_leaf_not_composable(self):
+        # sequential application REPLACES the leaf then merges into the
+        # replacement; no single merge patch expresses that against an
+        # arbitrary target
+        assert (
+            try_compose_merge_patch({"spec": 1}, {"spec": {"a": 2}}) is None
+        )
+
+    def test_resource_version_lock_never_composed(self):
+        locked = {"metadata": {"resourceVersion": "5", "labels": {"a": "1"}}}
+        free = {"metadata": {"labels": {"b": "2"}}}
+        assert try_compose_merge_patch(locked, free) is None
+        assert try_compose_merge_patch(free, locked) is None
+
+
+# ---------------------------------------------------- recording fake cluster
+class RecordingCluster:
+    """Duck-typed ClusterClient recording per-key application order and
+    per-key/global concurrency, with optional per-call delay and
+    injected failures."""
+
+    def __init__(self, delays=None, fail=None, batch_fail=None):
+        self.lock = threading.Lock()
+        self.applied = defaultdict(list)
+        self.active_keys = set()
+        self.active = 0
+        self.max_active = 0
+        self.overlapped_keys = []
+        self._delays = delays or (lambda op: 0.0)
+        self._fail = fail or (lambda op: None)
+        #: Raised from batch_write BEFORE any item applies — APF sheds a
+        #: whole POST at admission (per-item errors inside a batch are
+        #: per-item verdicts, deliberately not transport overload).
+        self._batch_fail = batch_fail or (lambda: None)
+
+    def _apply(self, kind, name, namespace, marker, op):
+        key = (kind, namespace, name)
+        with self.lock:
+            if key in self.active_keys:
+                self.overlapped_keys.append(key)
+            self.active_keys.add(key)
+            self.active += 1
+            self.max_active = max(self.max_active, self.active)
+        try:
+            delay = self._delays(op)
+            if delay:
+                time.sleep(delay)
+            err = self._fail(op)
+            if err is not None:
+                raise err
+            with self.lock:
+                self.applied[key].append(marker)
+            return {
+                "kind": kind,
+                "metadata": {"name": name, "resourceVersion": "1"},
+            }
+        finally:
+            with self.lock:
+                self.active_keys.discard(key)
+                self.active -= 1
+
+    def patch(self, kind, name, body, namespace="", patch_type="merge"):
+        marker = body.get("marker", body)
+        return self._apply(kind, name, namespace, marker, "patch")
+
+    def delete(self, kind, name, namespace="", grace_period_seconds=None):
+        self._apply(kind, name, namespace, "delete", "delete")
+
+    def batch_write(self, ops):
+        err = self._batch_fail()
+        if err is not None:
+            raise err
+        return [apply_write_op(self, op) for op in ops]
+
+
+def _non_composable_body(n: int) -> dict:
+    # an optimistic-lock rv suppresses coalescing categorically (each
+    # write's conflict check must run against the server), so every
+    # submission individually ships and the recorded order is a
+    # complete transcript
+    return {"marker": n, "metadata": {"resourceVersion": str(n)}}
+
+
+# ------------------------------------------------------- ordered-per-object
+class TestOrderedPerObjectProperty:
+    """ISSUE-6 acceptance: randomized concurrent writes to overlapping
+    objects observe per-object program order and never deadlock, with
+    the dispatcher at max concurrency."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    @pytest.mark.parametrize("transport", ("batch", "per-op"))
+    def test_random_concurrent_fanout(self, seed, transport):
+        rng = random.Random(seed)
+        n_objects = rng.randint(2, 6)
+        n_threads = rng.randint(2, 5)
+        writes_per_thread = rng.randint(10, 40)
+        objects = [f"node-{i}" for i in range(n_objects)]
+        delays = {
+            name: rng.choice((0.0, 0.0, 0.001, 0.003)) for name in objects
+        }
+        cluster = RecordingCluster(delays=lambda op: delays.get(op, 0.0))
+        dispatcher = WriteDispatcher(
+            cluster,
+            max_workers=8,
+            max_batch=rng.choice((1, 4, 16)),
+            use_batch=(transport == "batch"),
+        )
+        submitted = defaultdict(list)
+        submit_lock = threading.Lock()
+        counter = iter(range(10**6))
+
+        def submitter(thread_seed):
+            local = random.Random(thread_seed)
+            for _ in range(writes_per_thread):
+                name = local.choice(objects)
+                # submit under the bookkeeping lock so the recorded
+                # per-key order IS the dispatcher's submit order
+                with submit_lock:
+                    n = next(counter)
+                    body = _non_composable_body(n)
+                    submitted[("Node", "", name)].append(n)
+                    dispatcher.submit(
+                        WriteOp(op="patch", kind="Node", name=name, body=body)
+                    )
+                if local.random() < 0.2:
+                    time.sleep(0.001)
+
+        threads = [
+            threading.Thread(target=submitter, args=(seed * 31 + t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dispatcher.flush(timeout=30.0)  # raises on deadlock/stall
+        dispatcher.close()
+        assert cluster.overlapped_keys == [], (
+            "a key had two writes in flight at once"
+        )
+        for key, order in submitted.items():
+            assert cluster.applied[key] == order, key
+
+    def test_worker_cap_respected_under_load(self):
+        cluster = RecordingCluster(delays=lambda op: 0.002)
+        dispatcher = WriteDispatcher(
+            cluster, max_workers=3, max_batch=1, use_batch=False
+        )
+        for i in range(60):
+            dispatcher.submit(
+                WriteOp(
+                    op="patch",
+                    kind="Node",
+                    name=f"n{i}",
+                    body=_non_composable_body(i),
+                )
+            )
+        dispatcher.flush(timeout=30.0)
+        dispatcher.close()
+        assert cluster.max_active <= 3
+
+    def test_keyed_mutex_serializes_against_synchronous_writers(self):
+        mutex = KeyedMutex()
+        cluster = RecordingCluster()
+        dispatcher = WriteDispatcher(
+            cluster,
+            max_workers=4,
+            max_batch=8,
+            mutex=mutex,
+            mutex_key=lambda op: op.name or None,
+            use_batch=False,
+        )
+        entered = threading.Event()
+        release = threading.Event()
+
+        def synchronous_writer():
+            with mutex.lock("n0"):
+                entered.set()
+                release.wait(5.0)
+                cluster.patch("Node", "n0", {"marker": "sync"})
+
+        t = threading.Thread(target=synchronous_writer)
+        t.start()
+        entered.wait(5.0)
+        done = threading.Event()
+        dispatcher.submit(
+            WriteOp(op="patch", kind="Node", name="n0", body={"marker": "d"}),
+            lambda obj, err: done.set(),
+        )
+        # the dispatched write must be stuck behind the held lock
+        time.sleep(0.2)
+        assert cluster.applied[("Node", "", "n0")] == []
+        release.set()
+        t.join(5.0)
+        assert done.wait(5.0)
+        dispatcher.close()
+        assert cluster.applied[("Node", "", "n0")] == ["sync", "d"]
+
+
+# -------------------------------------------------------------- coalescing
+class TestCoalescing:
+    def test_same_object_merge_patches_collapse(self):
+        gate = threading.Event()
+        cluster = RecordingCluster(
+            delays=lambda op: 0.0 if gate.wait(5.0) else 0.0
+        )
+        dispatcher = WriteDispatcher(
+            cluster, max_workers=1, max_batch=1, use_batch=False
+        )
+        results = []
+        # first write holds the single worker at the gate; the next two
+        # queue behind it and compose with each other
+        dispatcher.submit(
+            WriteOp(op="patch", kind="Node", name="hold", body={"marker": 0})
+        )
+        for i in (1, 2):
+            dispatcher.submit(
+                WriteOp(
+                    op="patch",
+                    kind="Node",
+                    name="n0",
+                    body={"metadata": {"labels": {f"k{i}": str(i)}}},
+                ),
+                lambda obj, err, i=i: results.append((i, err)),
+            )
+        gate.set()
+        dispatcher.flush(timeout=10.0)
+        dispatcher.close()
+        assert dispatcher.coalesced == 1
+        # ONE application carried both labels; both callbacks fired
+        applied = cluster.applied[("Node", "", "n0")]
+        assert len(applied) == 1
+        assert applied[0] == {
+            "metadata": {"labels": {"k1": "1", "k2": "2"}}
+        }
+        assert sorted(i for i, _ in results) == [1, 2]
+        assert all(err is None for _, err in results)
+
+    def test_non_composable_pairs_ship_separately(self):
+        gate = threading.Event()
+        cluster = RecordingCluster(
+            delays=lambda op: 0.0 if gate.wait(5.0) else 0.0
+        )
+        dispatcher = WriteDispatcher(
+            cluster, max_workers=1, max_batch=1, use_batch=False
+        )
+        dispatcher.submit(
+            WriteOp(op="patch", kind="Node", name="hold", body={"marker": 0})
+        )
+        for i in range(2):
+            dispatcher.submit(
+                WriteOp(
+                    op="patch",
+                    kind="Node",
+                    name="n0",
+                    body=_non_composable_body(i),
+                )
+            )
+        gate.set()
+        dispatcher.flush(timeout=10.0)
+        dispatcher.close()
+        assert dispatcher.coalesced == 0
+        assert cluster.applied[("Node", "", "n0")] == [0, 1]
+
+
+# ------------------------------------------------------------- backpressure
+class TestOverloadDrainAndRetry:
+    """A 429 surviving the transport's own Retry-After replays means the
+    server is browned out: the dispatcher must back off and re-send —
+    the write succeeds late rather than failing or being re-amplified."""
+
+    @pytest.mark.parametrize("transport", ("batch", "per-op"))
+    def test_dispatcher_backs_off_then_succeeds(self, transport):
+        remaining = {"n": 3}
+        lock = threading.Lock()
+
+        def fail(*_):
+            with lock:
+                if remaining["n"] > 0:
+                    remaining["n"] -= 1
+                    return TooManyRequestsError("browned out")
+            return None
+
+        cluster = RecordingCluster(fail=fail, batch_fail=fail)
+        dispatcher = WriteDispatcher(
+            cluster,
+            max_workers=2,
+            max_batch=4,
+            use_batch=(transport == "batch"),
+            overload_retries=6,
+            overload_backoff_s=0.005,
+        )
+        errors = []
+        for i in range(4):
+            dispatcher.submit(
+                WriteOp(
+                    op="patch",
+                    kind="Node",
+                    name=f"n{i}",
+                    body=_non_composable_body(i),
+                ),
+                lambda obj, err: errors.append(err),
+            )
+        dispatcher.flush(timeout=30.0)
+        dispatcher.close()
+        assert dispatcher.overload_backoffs >= 3
+        assert errors and all(e is None for e in errors)
+        total_applied = sum(len(v) for v in cluster.applied.values())
+        assert total_applied == 4
+
+    def test_exhausted_retries_fail_only_their_writes(self):
+        def fail(op):
+            return TooManyRequestsError("browned out forever")
+
+        cluster = RecordingCluster(fail=fail)
+        dispatcher = WriteDispatcher(
+            cluster,
+            max_workers=1,
+            max_batch=1,
+            use_batch=False,
+            overload_retries=1,
+            overload_backoff_s=0.001,
+        )
+        errors = []
+        dispatcher.submit(
+            WriteOp(op="patch", kind="Node", name="n0", body={"marker": 0}),
+            lambda obj, err: errors.append(err),
+        )
+        dispatcher.flush(timeout=10.0)
+        dispatcher.close()
+        assert len(errors) == 1
+        assert isinstance(errors[0], TooManyRequestsError)
+
+    def test_pdb_eviction_429_is_not_replayed_per_op(self):
+        """An eviction's PDB 429 is a per-item semantic verdict the
+        caller's drain loop owns — the dispatcher must hand it straight
+        back, not burn backoff retries on it."""
+        calls = {"n": 0}
+
+        class PdbCluster:
+            def evict(self, name, namespace, grace_period_seconds=None):
+                calls["n"] += 1
+                raise TooManyRequestsError("pdb budget exhausted")
+
+        dispatcher = WriteDispatcher(
+            PdbCluster(),
+            max_workers=1,
+            max_batch=1,
+            use_batch=False,
+            overload_retries=5,
+            overload_backoff_s=0.001,
+        )
+        errors = []
+        dispatcher.submit(
+            WriteOp(op="evict", kind="Pod", name="p0", namespace="ns"),
+            lambda obj, err: errors.append(err),
+        )
+        dispatcher.flush(timeout=10.0)
+        dispatcher.close()
+        assert calls["n"] == 1
+        assert isinstance(errors[0], TooManyRequestsError)
+        assert dispatcher.overload_backoffs == 0
+
+
+# ----------------------------------------------------------- error fidelity
+class TestPerItemErrors:
+    def test_ignore_not_found_swallows_delete_of_gone_object(self):
+        cluster = InMemoryCluster()
+        dispatcher = WriteDispatcher(
+            cluster, max_workers=1, max_batch=1, use_batch=False
+        )
+        errors = []
+        dispatcher.submit(
+            WriteOp(
+                op="delete",
+                kind="Pod",
+                name="gone",
+                namespace="ns",
+                ignore_not_found=True,
+            ),
+            lambda obj, err: errors.append(err),
+        )
+        dispatcher.submit(
+            WriteOp(op="delete", kind="Pod", name="gone2", namespace="ns"),
+            lambda obj, err: errors.append(err),
+        )
+        dispatcher.flush(timeout=10.0)
+        dispatcher.close()
+        assert errors[0] is None
+        assert isinstance(errors[1], NotFoundError)
+
+    def test_one_bad_write_never_fails_its_batchmates(self):
+        cluster = InMemoryCluster()
+        cluster.create({"kind": "Node", "metadata": {"name": "good"}})
+        dispatcher = WriteDispatcher(
+            cluster, max_workers=1, max_batch=8, use_batch=True
+        )
+        outcomes = {}
+        for name in ("good", "missing"):
+            dispatcher.submit(
+                WriteOp(
+                    op="patch",
+                    kind="Node",
+                    name=name,
+                    body={"metadata": {"labels": {"a": "1"}}},
+                ),
+                lambda obj, err, name=name: outcomes.setdefault(name, err),
+            )
+        dispatcher.flush(timeout=10.0)
+        dispatcher.close()
+        assert outcomes["good"] is None
+        assert isinstance(outcomes["missing"], ApiError)
+        assert (
+            cluster.get("Node", "good")["metadata"]["labels"]["a"] == "1"
+        )
+
+
+class TestFailFastPerKey:
+    def test_failed_write_cancels_queued_same_key_successors(self):
+        """The synchronous contract: a raise prevents the next write
+        from ever being issued — a cordon patch failing must not let
+        the node's queued state-label patch advance it anyway.  The
+        successor fails with the predecessor's error, unapplied;
+        writes for OTHER keys are untouched."""
+        cluster = InMemoryCluster()
+        cluster.create({"kind": "Node", "metadata": {"name": "bystander"}})
+        gate = threading.Event()
+
+        class GatedCluster:
+            def __init__(self, inner):
+                self.inner = inner
+                self.first = True
+
+            def patch(self, kind, name, body, **kw):
+                if self.first:
+                    self.first = False
+                    gate.wait(5.0)  # hold key in flight until queued
+                return self.inner.patch(kind, name, body, **kw)
+
+            def __getattr__(self, attr):
+                return getattr(self.inner, attr)
+
+        dispatcher = WriteDispatcher(
+            GatedCluster(cluster), max_workers=2, max_batch=1, use_batch=False
+        )
+        outcomes = {}
+        # rv-locked so the successor can never coalesce into it
+        dispatcher.submit(
+            WriteOp(
+                op="patch",
+                kind="Node",
+                name="missing",
+                body={
+                    "metadata": {
+                        "resourceVersion": "1",
+                        "labels": {"a": "1"},
+                    }
+                },
+            ),
+            lambda obj, err: outcomes.setdefault("first", err),
+        )
+        dispatcher.submit(
+            WriteOp(
+                op="patch",
+                kind="Node",
+                name="missing",
+                body={"metadata": {"labels": {"b": "2"}}},
+            ),
+            lambda obj, err: outcomes.setdefault("second", err),
+        )
+        dispatcher.submit(
+            WriteOp(
+                op="patch",
+                kind="Node",
+                name="bystander",
+                body={"metadata": {"labels": {"c": "3"}}},
+            ),
+            lambda obj, err: outcomes.setdefault("bystander", err),
+        )
+        gate.set()
+        dispatcher.flush(timeout=10.0)
+        dispatcher.close()
+        assert isinstance(outcomes["first"], NotFoundError)
+        assert outcomes["second"] is outcomes["first"]
+        assert outcomes["bystander"] is None
+        assert (
+            cluster.get("Node", "bystander")["metadata"]["labels"]["c"]
+            == "3"
+        )
+
+
+class TestBulkVisibilityProbe:
+    """The cache's bulk rv probe (`resource_versions_of`) that the
+    post-wave visibility settle rides: one staleness check + one lock
+    hold for the whole name set, answer-identical to per-name probes."""
+
+    def test_bulk_matches_per_name(self):
+        from k8s_operator_libs_tpu.cluster.cache import InformerCache
+
+        cluster = InMemoryCluster()
+        for name in ("n0", "n1"):
+            cluster.create({"kind": "Node", "metadata": {"name": name}})
+        cache = InformerCache(cluster, lag_seconds=0.001)
+        cache.sync()
+        names = ["n0", "n1", "ghost"]
+        bulk = cache.resource_versions_of("Node", names)
+        assert bulk == {
+            name: cache.resource_version_of("Node", name) for name in names
+        }
+        assert bulk["n0"] is not None and bulk["ghost"] is None
+
+    def test_bulk_passthrough_when_always_fresh(self):
+        from k8s_operator_libs_tpu.cluster.cache import InformerCache
+
+        cluster = InMemoryCluster()
+        cluster.create({"kind": "Node", "metadata": {"name": "n0"}})
+        cache = InformerCache(cluster, lag_seconds=0.0)
+        bulk = cache.resource_versions_of("Node", ["n0", "ghost"])
+        assert bulk["n0"] == cache.resource_version_of("Node", "n0")
+        assert bulk["ghost"] is None
+
+
+# ------------------------------------------------- serial/pipelined parity
+class TestSerialPipelinedEquivalence:
+    """Acceptance: a pipelined rollout produces the same final cluster
+    state as the serial client on the same seed (volatile store-assigned
+    metadata and wall-clock stamps normalized — uids carry a random
+    per-cluster prefix and timeline/done-at annotations carry real
+    timestamps by design)."""
+
+    VOLATILE_META = ("resourceVersion", "uid", "creationTimestamp")
+
+    def _normalized_dump(self, cluster) -> str:
+        from k8s_operator_libs_tpu.upgrade import util as upgrade_util
+
+        stamped_keys = {
+            upgrade_util.get_timeline_annotation_key(),
+            upgrade_util.get_done_at_annotation_key(),
+            upgrade_util.get_admitted_at_annotation_key(),
+            upgrade_util.get_last_failure_at_annotation_key(),
+        }
+        def scrub(value):
+            if isinstance(value, dict):
+                out = {}
+                for k, v in value.items():
+                    if k in self.VOLATILE_META:
+                        continue  # uids ride ownerReferences too
+                    if k in stamped_keys:
+                        out[k] = "<stamp>"
+                    else:
+                        out[k] = scrub(v)
+                return out
+            if isinstance(value, list):
+                return [scrub(v) for v in value]
+            return value
+
+        snap = cluster.snapshot()
+        out = {"/".join(key): scrub(obj) for key, obj in snap.items()}
+        return json.dumps(out, sort_keys=True)
+
+    def _rollout(self, seed: int, workers: int) -> str:
+        from k8s_operator_libs_tpu.api import (
+            DrainSpec,
+            IntOrString,
+            UpgradePolicySpec,
+        )
+        from k8s_operator_libs_tpu.upgrade import consts
+        from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+            ClusterUpgradeStateManager,
+        )
+
+        from harness import DRIVER_LABELS, NAMESPACE, Fleet
+
+        rng = random.Random(seed)
+        cluster = InMemoryCluster()
+        fleet = Fleet(cluster, revision_hash="rev1")
+        slices = rng.randint(2, 4)
+        for s in range(slices):
+            for h in range(rng.randint(2, 3)):
+                fleet.add_node(
+                    f"s{s}-h{h}",
+                    labels={consts.SLICE_ID_LABEL_KEYS[0]: f"sl-{s}"},
+                )
+        fleet.publish_new_revision("rev2")
+        manager = ClusterUpgradeStateManager(
+            cluster,
+            cascade=True,
+            write_pipeline_workers=workers,
+            cache_sync_timeout_seconds=5.0,
+            cache_sync_poll_seconds=0.005,
+        )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=IntOrString("50%"),
+            slice_aware=True,
+            drain_spec=DrainSpec(enable=True, force=True, timeout_second=30),
+        )
+        try:
+            for _ in range(300):
+                state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+                manager.apply_state(state, policy)
+                manager.drain_manager.wait_idle(30.0)
+                manager.pod_manager.wait_idle(30.0)
+                fleet.reconcile_daemonset()
+                if fleet.all_done():
+                    break
+            else:
+                raise AssertionError("rollout did not converge")
+        finally:
+            manager.shutdown()
+        return self._normalized_dump(cluster)
+
+    @pytest.mark.parametrize("seed", (0, 1))
+    def test_pipelined_rollout_matches_serial_final_state(self, seed):
+        serial = self._rollout(seed, workers=0)
+        pipelined = self._rollout(seed, workers=8)
+        assert serial == pipelined
