@@ -11,8 +11,8 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 
 .PHONY: all test test-fast lint bench bench-scale bench-http smoke graft-check cov \
 	cov-report clean help image .build-image kind-e2e kind-e2e-stub \
-	tpu-smoke tpu-probe tpu-watch tpu-stage verify-obs verify-remediation \
-	verify-slo
+	tpu-smoke tpu-probe tpu-watch tpu-stage verify verify-obs \
+	verify-remediation verify-slo verify-events
 
 # Enforced coverage floor (VERDICT r4 next #6).  Full-suite line
 # coverage measured by the zero-dependency sys.monitoring tracer
@@ -60,6 +60,18 @@ verify-remediation:
 verify-slo:
 	$(PYTHON) -m pytest tests/test_slo.py -q
 	$(PYTHON) -m k8s_operator_libs_tpu slo --selftest
+
+# Decision-audit gate: the events/explain suite plus the in-process
+# end-to-end smoke (fleet → deferral → breaker trip → `explain` answers
+# with machine-readable reason codes via the live manager, a real
+# /debug/explain + /debug/events GET, and an offline dump).
+verify-events:
+	$(PYTHON) -m pytest tests/test_events.py -q
+	$(PYTHON) -m k8s_operator_libs_tpu explain --selftest
+
+# The whole verify chain — every subsystem gate in one target (CI runs
+# this; each sub-gate stays runnable alone for the inner loop).
+verify: verify-obs verify-remediation verify-slo verify-events
 
 lint:
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu examples bench.py __graft_entry__.py

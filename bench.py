@@ -552,50 +552,161 @@ def bench_timeline_slo(
       disabled recorder, as a percent overhead (acceptance: <= 5%);
     * ``slo_eval_ms_1024n`` — one SLO-engine evaluation (analytics +
       declared-target checks + gauge publication) over a full fleet's
-      worth of synthesized lifecycles.
+      worth of synthesized lifecycles;
+    * ``event_overhead_pct_1024n`` — the decision-event WORST case:
+      every cycle the whole 1,024-node pending fleet is deferred by a
+      closed maintenance window, i.e. 1,024 reason-coded emissions into
+      the dedup ring per reconcile, A/B'd against a disabled log
+      (acceptance: <= 5% — same gate as the flight recorder; a
+      steady-state fleet emits nothing at all).
     """
-    from k8s_operator_libs_tpu.api import SloSpec
+    from k8s_operator_libs_tpu.api import MaintenanceWindowSpec, SloSpec
+    from k8s_operator_libs_tpu.obs import events as events_mod
     from k8s_operator_libs_tpu.obs import slo as slo_mod
-    from k8s_operator_libs_tpu.upgrade import FlightRecorder, consts
+    from k8s_operator_libs_tpu.upgrade import (
+        FlightRecorder,
+        consts,
+        timeline as timeline_mod,
+    )
 
     nodes = slices * hosts
 
-    def steady_loop(recorder: FlightRecorder) -> float:
-        cluster = InMemoryCluster()
-        fleet = Fleet(cluster, revision_hash="rev1")
-        for s in range(slices):
-            for h in range(hosts):
-                fleet.add_node(f"s{s:03d}-h{h}")
-        cache = InformerCache(cluster, lag_seconds=0.0)
-        manager = ClusterUpgradeStateManager(
-            cluster,
-            cache=cache,
-            flight_recorder=recorder,
-            cache_sync_timeout_seconds=5.0,
-            cache_sync_poll_seconds=0.005,
-        )
-        try:
-            # settle: every node classifies unknown -> done (pods are
-            # already at the newest revision), so the timed loop below
-            # measures the steady-state recorder sweep, not transitions
-            for _ in range(3):
-                state = manager.build_state(NAMESPACE, DRIVER_LABELS)
-                manager.apply_state(state, policy)
-            t0 = time.perf_counter()
-            for i in range(cycles):
-                cluster.patch(
-                    "Node",
-                    "s000-h0",
-                    {"metadata": {"annotations": {"bench/touch": str(i)}}},
-                )
-                state = manager.build_state(NAMESPACE, DRIVER_LABELS)
-                manager.apply_state(state, policy)
-            return time.perf_counter() - t0
-        finally:
-            manager.shutdown()
+    def interleaved_overhead_pct(run_cycle, set_side, pairs: int) -> float:
+        """Median per-pair overhead of side True vs side False with the
+        two sides interleaved at CYCLE granularity.  Why: the ≤5% gates
+        these probes feed sit far below this box's noise floor — CPU
+        speed itself drifts ±15% over seconds (steal/frequency), so two
+        monolithic A/B runs minutes apart cannot resolve a 2% signal.
+        Adjacent cycles DO share the box's momentary speed, so each
+        pair's ratio is clean, and the median sheds scheduler spikes.
+        Two further confounds handled here: side order is RANDOMIZED
+        per pair (a deterministic A/B/B/A pattern aliased with the
+        collector's periodic gen-2 spikes, pinning +35%/-25% biases on
+        one side), and a full gc.collect() runs before each pair so no
+        aged collection lands inside a timed window."""
+        import gc
+        import random
 
-    t_off = min(steady_loop(FlightRecorder(enabled=False)) for _ in range(2))
-    t_on = min(steady_loop(FlightRecorder()) for _ in range(2))
+        rng = random.Random(0x5eed)
+        ratios = []
+        for _ in range(pairs):
+            sides = (False, True) if rng.random() < 0.5 else (True, False)
+            gc.collect()
+            sample = {}
+            for enabled in sides:
+                set_side(enabled)
+                t0 = time.perf_counter()
+                run_cycle()
+                sample[enabled] = time.perf_counter() - t0
+            ratios.append(sample[True] / max(sample[False], 1e-9))
+        ratios.sort()
+        # interquartile mean: averages the central half of the pair
+        # ratios — keeps the median's outlier immunity while using 15
+        # samples instead of 2, which is what holds run-to-run spread
+        # inside a ±1% band around the true overhead
+        lo, hi = len(ratios) // 4, len(ratios) - len(ratios) // 4
+        middle = ratios[lo:hi]
+        return (sum(middle) / len(middle) - 1) * 100
+
+    # ---- timeline overhead: a steady fleet, one node touched per cycle
+    cluster = InMemoryCluster()
+    fleet = Fleet(cluster, revision_hash="rev1")
+    for s in range(slices):
+        for h in range(hosts):
+            fleet.add_node(f"s{s:03d}-h{h}")
+    manager = ClusterUpgradeStateManager(
+        cluster,
+        cache=InformerCache(cluster, lag_seconds=0.0),
+        # flight_recorder unset: the manager resolves the process
+        # default per use, which is how the interleaver flips sides
+        cache_sync_timeout_seconds=5.0,
+        cache_sync_poll_seconds=0.005,
+    )
+    recorders = {
+        True: FlightRecorder(),
+        False: FlightRecorder(enabled=False),
+    }
+    prev_recorder = timeline_mod.set_default_recorder(recorders[True])
+    touch = {"i": 0}
+    try:
+        # settle: every node classifies unknown -> done (pods are
+        # already at the newest revision), so the timed cycles measure
+        # the steady-state recorder sweep, not transitions
+        for _ in range(3):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+
+        def steady_cycle() -> None:
+            touch["i"] += 1
+            cluster.patch(
+                "Node",
+                "s000-h0",
+                {"metadata": {"annotations": {"bench/touch": str(touch["i"])}}},
+            )
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+
+        timeline_overhead_pct = interleaved_overhead_pct(
+            steady_cycle,
+            lambda enabled: timeline_mod.set_default_recorder(
+                recorders[enabled]
+            ),
+            pairs=max(8, cycles),
+        )
+    finally:
+        manager.shutdown()
+        timeline_mod.set_default_recorder(prev_recorder)
+
+    # ---- decision-event overhead: the WORST case — a fully-gated
+    # pending fleet, every node deferred (window closed) every cycle
+    from datetime import datetime, timedelta, timezone
+
+    cluster = InMemoryCluster()
+    fleet = Fleet(cluster, revision_hash="rev1")
+    for s in range(slices):
+        for h in range(hosts):
+            fleet.add_node(f"g{s:03d}-h{h}")
+    fleet.publish_new_revision("rev2")
+    opens = datetime.now(timezone.utc) + timedelta(hours=6)
+    gated_policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        # a 1-hour window opening 6 hours from now is closed for the
+        # whole measurement, whatever the wall clock says
+        maintenance_window=MaintenanceWindowSpec(
+            start=f"{opens.hour:02d}:{opens.minute:02d}",
+            duration_minutes=60,
+        ),
+    )
+    manager = ClusterUpgradeStateManager(
+        cluster,
+        cache=InformerCache(cluster, lag_seconds=0.0),
+        cache_sync_timeout_seconds=5.0,
+        cache_sync_poll_seconds=0.005,
+    )
+    logs = {
+        True: events_mod.DecisionEventLog(),
+        False: events_mod.DecisionEventLog(enabled=False),
+    }
+    prev_log = events_mod.set_default_log(logs[True])
+    try:
+        # settle: unknown -> upgrade-required (pods at rev1, target
+        # rev2), so the timed cycles are pure deferrals
+        for _ in range(2):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, gated_policy)
+
+        def gated_cycle() -> None:
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, gated_policy)
+
+        event_overhead_pct = interleaved_overhead_pct(
+            gated_cycle,
+            lambda enabled: events_mod.set_default_log(logs[enabled]),
+            pairs=max(8, cycles),
+        )
+    finally:
+        manager.shutdown()
+        events_mod.set_default_log(prev_log)
 
     # SLO evaluation latency over a fleet's worth of lifecycles shaped
     # like a live mid-rollout: a few nodes still OPEN in drain (their
@@ -651,8 +762,9 @@ def bench_timeline_slo(
         engine.evaluate(_Counts, slo_policy)
     eval_ms = (time.perf_counter() - t0) / evals * 1000
     return {
-        f"timeline_overhead_pct_{nodes}n": round((t_on / t_off - 1) * 100, 2),
+        f"timeline_overhead_pct_{nodes}n": round(timeline_overhead_pct, 2),
         f"slo_eval_ms_{nodes}n": round(eval_ms, 2),
+        f"event_overhead_pct_{nodes}n": round(event_overhead_pct, 2),
     }
 
 

@@ -104,12 +104,19 @@ def run_real(args) -> int:
     state_index = ClusterStateIndex(
         client, args.namespace, labels, externally_fed=True
     )
+    # Decision-audit persistence: the reason-coded decision stream lands
+    # as real core/v1 Events (batched per reconcile; the apiserver
+    # TTL-GCs them), so `kubectl get events` / the `events`/`status`
+    # CLIs explain the rollout offline too.
+    from k8s_operator_libs_tpu.obs import events as events_mod
+
     manager = ClusterUpgradeStateManager(
         client,
         cache=cache,
         recorder=recorder,
         reads_from_cache=True,
         state_index=state_index,
+        decision_event_sink=events_mod.ClusterDecisionEventSink(client),
     )
 
     def make_controller():
@@ -178,6 +185,10 @@ def run_real(args) -> int:
             # a policy declaring an slos block)
             slo_source=manager.slo_status,
             timeline_source=manager.timeline_status,
+            # decision-audit stream + the explain plane ("why is node X
+            # not progressing" with a machine-readable reason code)
+            events_source=manager.events_status,
+            explain_source=manager.explain_node,
         ).start()
         ops.add_health_check("controller", runnable.running)
         # A hot HA standby is READY (it serves its purpose: being able
@@ -186,7 +197,7 @@ def run_real(args) -> int:
         print(
             f"ops endpoints on {ops.url} "
             "(/metrics /healthz /readyz /debug/traces /debug/remediation "
-            "/debug/slo /debug/timeline)"
+            "/debug/slo /debug/timeline /debug/events /debug/explain)"
         )
     started = False
     try:

@@ -30,6 +30,15 @@ Subcommands:
       python -m k8s_operator_libs_tpu traces --file traces.json
       python -m k8s_operator_libs_tpu traces --file traces.json --fmt chrome
       python -m k8s_operator_libs_tpu traces --selftest
+
+* ``explain`` / ``events`` — the decision-audit plane
+  (:mod:`.obs.events`): "why is node X not progressing" with a
+  machine-readable reason code, and the reason-coded decision stream
+  reconstructed from the persisted Event objects.
+
+      python -m k8s_operator_libs_tpu explain --state-file dump.json --node n17
+      python -m k8s_operator_libs_tpu events --kubeconfig --json
+      python -m k8s_operator_libs_tpu explain --selftest   # make verify-events
 """
 
 from __future__ import annotations
@@ -241,10 +250,19 @@ def cmd_status(args: argparse.Namespace) -> int:
             gates_noted = True
         if policy is not None:
             _push_topology_keys(policy)
+        # Decision-audit stream (obs/events.py): reconstructed from the
+        # persisted Event objects when the operator runs the sink —
+        # feeds the last-decisions line and the blocking gate's
+        # deferred-node citation.  Optional everywhere: a source without
+        # decision Events simply renders the pre-stream status.
+        from .obs import events as events_mod
+
+        decisions = events_mod.decisions_from_cluster(cluster)
         status = RolloutStatus.from_cluster_state(
             state,
             policy=policy,
             slo_report=slo_engine.evaluate(state, policy),
+            decisions=decisions or None,
         )
         payload = status.to_dict()
         rendered = json.dumps(payload) if args.json else status.render()
@@ -254,10 +272,18 @@ def cmd_status(args: argparse.Namespace) -> int:
         # Breach membership and the straggler set ARE part of the key —
         # a newly wedged node must print immediately, not wait for an
         # unrelated bucket change.
+        # ... and likewise the decision stream's counts/timestamps (a
+        # gated fleet re-defers every reconcile): only the SET of
+        # distinct decisions is part of the key, so a NEW decision
+        # prints immediately but a repeat does not.
         slo = payload.get("slo") or {}
         change_key = json.dumps(
             {
-                **{k: v for k, v in payload.items() if k != "slo"},
+                **{
+                    k: v
+                    for k, v in payload.items()
+                    if k not in ("slo", "decisions")
+                },
                 "sloBreaches": sorted(
                     b.get("slo", "")
                     for b in (slo.get("slos") or {}).get("breaches") or []
@@ -265,6 +291,10 @@ def cmd_status(args: argparse.Namespace) -> int:
                 "stragglers": sorted(
                     s.get("node", "")
                     for s in slo.get("stragglers") or []
+                ),
+                "decisions": sorted(
+                    f"{d.get('type')}:{d.get('reason')}:{d.get('target')}"
+                    for d in payload.get("decisions") or []
                 ),
             },
             sort_keys=True,
@@ -557,6 +587,136 @@ def cmd_slo(args: argparse.Namespace) -> int:
     breaches = (report.get("slos") or {}).get("breaches") or []
     # poll-friendly: nonzero while a declared SLO is in breach
     return 3 if (breaches and args.wait_exit_code) else 0
+
+
+def _build_explain_inputs(args: argparse.Namespace, cluster):
+    """Shared offline/live assembly for ``events``/``explain``: the
+    snapshot, the (optional) policy, the checkpoint-reconstructed
+    flight recorder, the SLO report, and the decision stream
+    reconstructed from persisted Event objects.  Returns
+    (state, policy, recorder, slo_report, decisions, exit_code)."""
+    from .cluster.errors import ApiError
+    from .obs import events as events_mod, slo as slo_mod
+    from .upgrade import timeline as timeline_mod
+    from .upgrade.upgrade_state import UpgradeStateError
+
+    policy, prc, pmsg = _load_policy_cr(args, cluster)
+    if pmsg:
+        print(pmsg, file=sys.stderr)
+    if prc:
+        return None, None, None, None, None, prc
+    if policy is not None:
+        _push_topology_keys(policy)
+    recorder = timeline_mod.FlightRecorder()
+    manager = ClusterUpgradeStateManager(cluster, flight_recorder=recorder)
+    try:
+        state = manager.build_state(
+            args.namespace, _parse_selector_arg(args.selector)
+        )
+    except (ApiError, OSError, UpgradeStateError) as err:
+        print(f"cannot read cluster state: {err}", file=sys.stderr)
+        return None, None, None, None, None, 2
+    finally:
+        manager.shutdown()
+    slo_report = slo_mod.SloEngine(recorder).evaluate(state, policy)
+    decisions = events_mod.decisions_from_cluster(cluster)
+    return state, policy, recorder, slo_report, decisions, 0
+
+
+def cmd_explain(args: argparse.Namespace) -> int:
+    """Answer "why is node X not progressing" with a machine-readable
+    reason code — offline from a dump (node annotations + persisted
+    decision Events reconstruct the verdict) or live.  ``--selftest``
+    runs the end-to-end explain smoke (the ``make verify-events``
+    gate)."""
+    from .obs import events as events_mod
+
+    if args.selftest:
+        try:
+            print(events_mod.selftest())
+        except AssertionError as err:
+            print(f"events/explain selftest FAILED: {err}", file=sys.stderr)
+            return 1
+        return 0
+    if not args.node:
+        print("explain needs --node NAME (or --selftest)", file=sys.stderr)
+        return 2
+    cluster, rc = _open_source(args, "explain")
+    if cluster is None:
+        return rc
+    util.set_component_name(args.component)
+    state, policy, recorder, slo_report, decisions, rc = (
+        _build_explain_inputs(args, cluster)
+    )
+    if rc:
+        return rc
+    answer = events_mod.explain_node(
+        args.node,
+        state,
+        policy=policy,
+        recorder=recorder,
+        slo_report=slo_report,
+        decisions=decisions,
+    )
+    if answer is None:
+        print(
+            f"node {args.node} is not managed by this rollout "
+            f"(namespace {args.namespace}, selector {args.selector})",
+            file=sys.stderr,
+        )
+        return 3
+    if args.json:
+        print(json.dumps(answer))
+    else:
+        print(events_mod.render_explanation(answer))
+    return 0
+
+
+def cmd_events(args: argparse.Namespace) -> int:
+    """List the decision-audit stream from a source's persisted Event
+    objects (the live log is served at OpsServer ``/debug/events``).
+    ``--selftest`` runs the same end-to-end smoke as ``explain``."""
+    from .obs import events as events_mod
+
+    if args.selftest:
+        try:
+            print(events_mod.selftest())
+        except AssertionError as err:
+            print(f"events/explain selftest FAILED: {err}", file=sys.stderr)
+            return 1
+        return 0
+    cluster, rc = _open_source(args, "events")
+    if cluster is None:
+        return rc
+    util.set_component_name(args.component)
+    from .cluster.errors import ApiError
+
+    try:
+        # strict: an unreachable apiserver must exit 2, not render as
+        # "no persisted decision events"
+        decisions = events_mod.decisions_from_cluster(cluster, strict=True)
+    except (ApiError, OSError) as err:
+        print(f"cannot read events: {err}", file=sys.stderr)
+        return 2
+    if args.node:
+        decisions = [d for d in decisions if d.get("target") == args.node]
+    if args.type:
+        decisions = [d for d in decisions if d.get("type") == args.type]
+    if args.json:
+        print(json.dumps(decisions))
+        return 0
+    if not decisions:
+        print(
+            "no persisted decision events found (is the operator running "
+            "the decision-event sink?)"
+        )
+        return 0
+    for d in decisions:
+        print(
+            f"{d.get('lastTimestamp', '')}  "
+            + events_mod.format_decision_line(d)
+        )
+    return 0
 
 
 def cmd_repair(args: argparse.Namespace) -> int:
@@ -901,6 +1061,50 @@ def main(argv=None) -> int:
         "and exit 0/1 — the make verify-slo gate (no source needed)",
     )
     sl.set_defaults(func=cmd_slo)
+
+    ex = sub.add_parser(
+        "explain",
+        help="answer 'why is node X not progressing' with a machine-"
+        "readable reason code: current phase, first blocking gate, "
+        "retry/backoff state and the fleet ETA — offline from a dump or "
+        "live; --selftest smokes the decision-event pipeline end-to-end",
+    )
+    _add_source_args(ex)
+    _add_query_args(ex)
+    ex.add_argument("--node", default="", help="the node to explain")
+    ex.add_argument(
+        "--policy",
+        default="",
+        help="TpuUpgradePolicy name in the source; when set, the admission "
+        "gates are evaluated so a gate-blocked node names its gate",
+    )
+    ex.add_argument(
+        "--selftest",
+        action="store_true",
+        help="run the fleet → deferral → breaker-trip → explain smoke "
+        "across all three planes and exit 0/1 — the make verify-events "
+        "gate (no source needed)",
+    )
+    ex.set_defaults(func=cmd_explain)
+
+    ev = sub.add_parser(
+        "events",
+        help="list the reason-coded decision-audit stream from the "
+        "source's persisted Event objects (live stream: OpsServer "
+        "/debug/events); --selftest smokes the pipeline end-to-end",
+    )
+    _add_source_args(ev)
+    _add_query_args(ev)
+    ev.add_argument("--node", default="", help="only this node's decisions")
+    ev.add_argument(
+        "--type", default="", help="only this decision type (e.g. NodeDeferred)"
+    )
+    ev.add_argument(
+        "--selftest",
+        action="store_true",
+        help="same end-to-end smoke as `explain --selftest`",
+    )
+    ev.set_defaults(func=cmd_events)
 
     rp = sub.add_parser(
         "repair",

@@ -780,6 +780,7 @@ class ApiServerFacade:
         max_inflight: int = 0,
         ssl_context=None,
         batch_writes: bool = True,
+        event_ttl_seconds: Optional[float] = None,
     ) -> None:
         """*ssl_context*: an ``ssl.SSLContext`` (``PROTOCOL_TLS_SERVER``)
         to serve HTTPS — envtest parity (the reference's test apiserver
@@ -787,6 +788,10 @@ class ApiServerFacade:
         ``verify_mode=CERT_REQUIRED`` + ``load_verify_locations`` on it
         for mTLS client-certificate auth."""
         self.cluster = cluster
+        # Event retention override (kube-apiserver --event-ttl): the
+        # store owns the GC; this just configures it per facade.
+        if event_ttl_seconds is not None:
+            cluster.event_ttl_seconds = event_ttl_seconds
         #: Mutable: tests rotate the accepted set mid-run to force 401s
         #: (exec-plugin refresh path).  None = no auth required.
         self.accepted_tokens = accepted_tokens

@@ -219,6 +219,7 @@ class InMemoryCluster:
         crd_establish_delay_seconds: float = 0.0,
         termination_grace_scale: float = 1.0,
         use_indexes: bool = True,
+        event_ttl_seconds: float = 3600.0,
     ) -> None:
         self._lock = threading.RLock()
         #: Signaled on every journal append — the push half of
@@ -276,6 +277,15 @@ class InMemoryCluster:
         # only needs uniqueness, not cryptographic randomness.
         self._uid_prefix = uuid.uuid4().hex[:12]
         self._uid_seq = 0
+        #: Event retention — the kube-apiserver ``--event-ttl`` analog
+        #: (its default is 1h too): Event objects whose lastTimestamp
+        #: (falling back to firstTimestamp / creationTimestamp) is older
+        #: than this are garbage-collected.  0 disables.  GC runs lazily
+        #: on Event writes/lists, rate-limited, so a store that never
+        #: touches Events never pays for it; :meth:`gc_events` runs it
+        #: explicitly (tests pin the clock).
+        self.event_ttl_seconds = event_ttl_seconds
+        self._last_event_gc = 0.0
         # Copy-out accelerator: per-object marshal blob keyed by store
         # key, validated by the object's resourceVersion (every write
         # bumps rv through _next_rv, so a matching rv proves the blob is
@@ -422,6 +432,74 @@ class InMemoryCluster:
             else json_copy(stored)
         )
 
+    # ------------------------------------------------------------ event TTL GC
+    @staticmethod
+    def _event_stamp(obj: JsonObj) -> Optional[float]:
+        """The Event's age anchor as unix seconds: lastTimestamp (ISO
+        string, the recorder contract) → firstTimestamp →
+        creationTimestamp (already a float here).  None = unparseable —
+        such an Event is never GC'd (degrade to retention, not loss)."""
+        import datetime as _dt
+
+        for field_name in ("lastTimestamp", "firstTimestamp"):
+            raw = obj.get(field_name)
+            if isinstance(raw, (int, float)):
+                return float(raw)
+            if isinstance(raw, str) and raw:
+                try:
+                    return _dt.datetime.fromisoformat(
+                        raw.replace("Z", "+00:00")
+                    ).timestamp()
+                except ValueError:
+                    continue
+        created = (obj.get("metadata") or {}).get("creationTimestamp")
+        return float(created) if isinstance(created, (int, float)) else None
+
+    def gc_events(self, now: Optional[float] = None) -> int:
+        """Drop Event objects older than ``event_ttl_seconds`` (the
+        kube-apiserver ``--event-ttl`` analog); returns how many were
+        collected.  Deletions are journaled like any other delete, so
+        watchers/informers see them."""
+        ttl = self.event_ttl_seconds
+        if ttl <= 0:
+            return 0
+        now = time.time() if now is None else now
+        removed = 0
+        with self._lock:
+            self._last_event_gc = now
+            for key in list(self._by_kind.get("Event") or ()):
+                obj = self._store.get(key)
+                if obj is None:
+                    continue
+                stamp = self._event_stamp(obj)
+                if stamp is None or now - stamp < ttl:
+                    continue
+                old_blob = self._blob_of(key, obj, prime=False)
+                self._store_pop(key)
+                self._next_rv()
+                self._record(
+                    "Deleted",
+                    None if old_blob is not None else json_copy(obj),
+                    None,
+                    kind="Event",
+                    old_blob=old_blob,
+                )
+                removed += 1
+        return removed
+
+    def _maybe_gc_events_locked(self) -> None:
+        """Opportunistic TTL sweep, rate-limited to once per minute —
+        called (under the lock) from Event writes and lists, so expired
+        Events age out without any background thread.  Caller holds the
+        RLock; gc_events re-enters it harmlessly."""
+        ttl = self.event_ttl_seconds
+        if ttl <= 0:
+            return
+        now = time.time()
+        if now - self._last_event_gc < min(60.0, ttl / 4.0):
+            return
+        self.gc_events(now)
+
     # -------------------------------------------------------------- admission
     def _admit(self, obj: JsonObj) -> None:
         """Structural-schema admission (envtest behavior): apply the
@@ -463,6 +541,8 @@ class InMemoryCluster:
     def create(self, obj: JsonObj) -> JsonObj:
         with self._lock:
             key = _key_of(obj)
+            if key[0] == "Event":
+                self._maybe_gc_events_locked()
             if key in self._store:
                 raise AlreadyExistsError(f"{key} already exists")
             stored = json_copy(obj)
@@ -549,6 +629,8 @@ class InMemoryCluster:
         client would filter after the fact)."""
         with self._lock:
             self.list_ops += 1
+            if kind == "Event":
+                self._maybe_gc_events_locked()
             matches = self._scan(
                 kind, namespace, label_selector, field_filter, field_selector
             )

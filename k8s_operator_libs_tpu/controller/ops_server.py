@@ -29,9 +29,18 @@ server exposing
   timelines when a *timeline_source* was wired (usually
   ``manager.timeline_status``); ``?node=<name>`` filters to one node
   (404 when the node has no timeline);
+* ``GET /debug/events`` — the reason-coded decision-event stream
+  (:mod:`..obs.events`) when an *events_source* was wired (usually
+  ``manager.events_status``); ``?node=`` / ``?type=`` / ``?limit=``
+  filter;
+* ``GET /debug/explain`` — "why is node X not progressing" when an
+  *explain_source* was wired (usually ``manager.explain_node``);
+  ``?node=<name>`` is required (400 without it, 404 for an unknown
+  node);
 * ``GET /debug`` — JSON index of the debug endpoints registered on THIS
   server (so an operator can discover what is wired without guessing
-  paths).
+  paths).  The index is derived from the route REGISTRY — a registered
+  endpoint cannot be missing from it (regression-tested).
 
 ``/metrics`` also honors ``Accept: application/openmetrics-text`` with
 the OpenMetrics rendering, whose histogram ``+Inf`` bucket lines carry
@@ -89,6 +98,8 @@ class OpsServer:
         remediation_source: Optional[Callable[[], Optional[dict]]] = None,
         slo_source: Optional[Callable[[], Optional[dict]]] = None,
         timeline_source: Optional[Callable[..., dict]] = None,
+        events_source: Optional[Callable[[], Optional[dict]]] = None,
+        explain_source: Optional[Callable[[str], Optional[dict]]] = None,
     ) -> None:
         # All-interfaces default, like controller-runtime's metrics/probe
         # listeners: kubelet probes and Prometheus scrapes arrive on the
@@ -129,6 +140,33 @@ class OpsServer:
                 )
             except (TypeError, ValueError):  # uninspectable callable
                 self._timeline_takes_node = False
+        #: Callable returning the decision-event log snapshot; absent
+        #: means /debug/events 404s.
+        self._events_source = events_source
+        #: Callable answering explain_node(name); absent means
+        #: /debug/explain 404s.
+        self._explain_source = explain_source
+        # THE debug route registry: path -> handler(query).  The /debug
+        # index is DERIVED from this dict, so a wired endpoint can never
+        # be missing from it (the index used to be maintained by hand —
+        # regression-tested in tests/test_events.py).  Insertion order
+        # is the index order.
+        self._debug_routes: Dict[
+            str, Callable[[Dict[str, list]], Tuple[int, str, bytes]]
+        ] = {}
+        self._debug_routes["/debug/traces"] = self._render_traces
+        if remediation_source is not None:
+            self._debug_routes["/debug/remediation"] = (
+                self._render_remediation
+            )
+        if slo_source is not None:
+            self._debug_routes["/debug/slo"] = self._render_slo
+        if timeline_source is not None:
+            self._debug_routes["/debug/timeline"] = self._render_timeline
+        if events_source is not None:
+            self._debug_routes["/debug/events"] = self._render_events
+        if explain_source is not None:
+            self._debug_routes["/debug/explain"] = self._render_explain
         self._health_checks: Dict[str, Check] = {}
         self._ready_checks: Dict[str, Check] = {}
         self._lock = threading.Lock()
@@ -205,6 +243,119 @@ class OpsServer:
             )
         return 200, "application/json", (json.dumps(payload) + "\n").encode()
 
+    def _render_remediation(
+        self, _query: Dict[str, list]
+    ) -> Tuple[int, str, bytes]:
+        status = self._remediation_source()
+        payload = {"configured": True, "decision": status}
+        return (
+            200,
+            "application/json",
+            (json.dumps(payload) + "\n").encode(),
+        )
+
+    def _render_slo(self, _query: Dict[str, list]) -> Tuple[int, str, bytes]:
+        payload = {"configured": True, "report": self._slo_source()}
+        return (
+            200,
+            "application/json",
+            (json.dumps(payload) + "\n").encode(),
+        )
+
+    def _render_timeline(
+        self, query: Dict[str, list]
+    ) -> Tuple[int, str, bytes]:
+        node = (query.get("node") or [""])[0]
+        if node:
+            # filter at the SOURCE when it supports it (the flight
+            # recorder does): a single-node query must not
+            # serialize the whole fleet's timelines per hit
+            if self._timeline_takes_node:
+                snapshot = self._timeline_source(node) or {}
+            else:
+                snapshot = self._timeline_source() or {}
+            hits = [
+                t
+                for t in snapshot.get("timelines") or []
+                if t.get("node") == node
+            ]
+            if not hits:
+                return (
+                    404,
+                    "text/plain; charset=utf-8",
+                    f"no timeline for node {node}\n".encode(),
+                )
+            snapshot = dict(snapshot, nodes=len(hits), timelines=hits)
+        else:
+            snapshot = self._timeline_source() or {}
+        return (
+            200,
+            "application/json",
+            (json.dumps(snapshot) + "\n").encode(),
+        )
+
+    def _render_events(
+        self, query: Dict[str, list]
+    ) -> Tuple[int, str, bytes]:
+        payload = dict(self._events_source() or {})
+        events = payload.get("events") or []
+        node = (query.get("node") or [""])[0]
+        type_ = (query.get("type") or [""])[0]
+        if node:
+            events = [e for e in events if e.get("target") == node]
+        if type_:
+            events = [e for e in events if e.get("type") == type_]
+        raw_limit = (query.get("limit") or [""])[0]
+        if raw_limit:
+            # LIST-limit convention: 0 = unlimited (like a Kubernetes
+            # LIST), negatives rejected — a silent -0 slice would have
+            # returned everything for limit=0 AND limit=-5 alike
+            try:
+                limit = int(raw_limit)
+            except ValueError:
+                limit = -1
+            if limit < 0:
+                return (
+                    400,
+                    "text/plain; charset=utf-8",
+                    f"limit must be a non-negative integer, got "
+                    f"{raw_limit!r}\n".encode(),
+                )
+            if limit > 0:
+                events = events[-limit:]
+        payload["events"] = events
+        payload["returned"] = len(events)
+        payload["configured"] = True
+        return (
+            200,
+            "application/json",
+            (json.dumps(payload) + "\n").encode(),
+        )
+
+    def _render_explain(
+        self, query: Dict[str, list]
+    ) -> Tuple[int, str, bytes]:
+        node = (query.get("node") or [""])[0]
+        if not node:
+            return (
+                400,
+                "text/plain; charset=utf-8",
+                b"explain needs ?node=<name>\n",
+            )
+        answer = self._explain_source(node)
+        if answer is None:
+            return (
+                404,
+                "text/plain; charset=utf-8",
+                f"no explanation for node {node} (unknown node, or no "
+                f"reconcile yet)\n".encode(),
+            )
+        return (
+            200,
+            "application/json",
+            (json.dumps(answer) + "\n").encode(),
+        )
+
     def _respond(
         self, raw_path: str, accept: str = ""
     ) -> Tuple[int, str, bytes]:
@@ -229,84 +380,19 @@ class OpsServer:
                 "text/plain; charset=utf-8",
                 ("\n".join(lines) + "\n").encode(),
             )
-        if path == "/debug/traces":
-            return self._render_traces(parse_qs(raw_query))
-        if path == "/debug/remediation":
-            if self._remediation_source is None:
-                return (
-                    404,
-                    "text/plain; charset=utf-8",
-                    b"remediation not configured\n",
-                )
-            status = self._remediation_source()
-            payload = {"configured": True, "decision": status}
-            return (
-                200,
-                "application/json",
-                (json.dumps(payload) + "\n").encode(),
-            )
-        if path == "/debug/slo":
-            if self._slo_source is None:
-                return (
-                    404,
-                    "text/plain; charset=utf-8",
-                    b"slo engine not configured\n",
-                )
-            payload = {"configured": True, "report": self._slo_source()}
-            return (
-                200,
-                "application/json",
-                (json.dumps(payload) + "\n").encode(),
-            )
-        if path == "/debug/timeline":
-            if self._timeline_source is None:
-                return (
-                    404,
-                    "text/plain; charset=utf-8",
-                    b"flight recorder not configured\n",
-                )
-            node = (parse_qs(raw_query).get("node") or [""])[0]
-            if node:
-                # filter at the SOURCE when it supports it (the flight
-                # recorder does): a single-node query must not
-                # serialize the whole fleet's timelines per hit
-                if self._timeline_takes_node:
-                    snapshot = self._timeline_source(node) or {}
-                else:
-                    snapshot = self._timeline_source() or {}
-                hits = [
-                    t
-                    for t in snapshot.get("timelines") or []
-                    if t.get("node") == node
-                ]
-                if not hits:
-                    return (
-                        404,
-                        "text/plain; charset=utf-8",
-                        f"no timeline for node {node}\n".encode(),
-                    )
-                snapshot = dict(snapshot, nodes=len(hits), timelines=hits)
-            else:
-                snapshot = self._timeline_source() or {}
-            return (
-                200,
-                "application/json",
-                (json.dumps(snapshot) + "\n").encode(),
-            )
+        handler = self._debug_routes.get(path)
+        if handler is not None:
+            return handler(parse_qs(raw_query))
         if path in ("/debug", "/debug/"):
-            # Discovery index instead of a 404: which debug endpoints
-            # are actually registered on THIS server.
-            endpoints = ["/debug/traces"]
-            if self._remediation_source is not None:
-                endpoints.append("/debug/remediation")
-            if self._slo_source is not None:
-                endpoints.append("/debug/slo")
-            if self._timeline_source is not None:
-                endpoints.append("/debug/timeline")
+            # Discovery index instead of a 404, derived from the route
+            # registry: a registered endpoint cannot be missing here.
             return (
                 200,
                 "application/json",
-                (json.dumps({"endpoints": endpoints}) + "\n").encode(),
+                (
+                    json.dumps({"endpoints": list(self._debug_routes)})
+                    + "\n"
+                ).encode(),
             )
         return 404, "text/plain; charset=utf-8", b"404 not found\n"
 

@@ -763,6 +763,30 @@ def record_batch_endpoint_fallback() -> None:
     ).inc()
 
 
+def upgrade_events_counter() -> Counter:
+    """The decision-event counter family (obs/events.py) — counted per
+    OCCURRENCE, so a node deferred every reconcile keeps counting even
+    while the log's dedup ring aggregates it into one entry (rate()
+    over this family is the deferral pressure signal the
+    UpgradeNodesDeferredSustained alert pages on).
+
+    Returns the metric OBJECT (the write-pipeline pattern): the
+    decision log caches the handle per registry — re-resolving through
+    the create-or-get lock per emission sat on the fully-gated fleet's
+    hot path."""
+    return default_registry().counter(
+        "upgrade_events_total",
+        "Reason-coded rollout decision events, by type and reason.",
+        ("type", "reason"),
+    )
+
+
+def record_upgrade_event(type_: str, reason: str) -> None:
+    """One-off form of :func:`upgrade_events_counter` for callers off
+    the hot path."""
+    upgrade_events_counter().inc(type_ or "unknown", reason or "unknown")
+
+
 def record_leader_transition(event: str) -> None:
     """Leader-election lifecycle: acquired | lost | released."""
     default_registry().counter(

@@ -8,7 +8,7 @@ live in :mod:`..metrics` (predating this package); the HTTP surface for
 both is :class:`~..controller.ops_server.OpsServer`.
 """
 
-from . import slo
+from . import events, slo
 from .tracing import (
     Span,
     TraceContextFilter,
@@ -30,6 +30,7 @@ from .tracing import (
 )
 
 __all__ = [
+    "events",
     "slo",
     "Span",
     "TraceContextFilter",

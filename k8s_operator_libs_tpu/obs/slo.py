@@ -490,9 +490,22 @@ class SloEngine:
             }
             with self._lock:
                 current = {b["slo"] for b in breaches}
-                for name in sorted(current - self._breached):
-                    metrics.record_slo_breach(name)
+                fresh = sorted(current - self._breached)
                 self._breached = current
+            detail_by_name = {b["slo"]: b.get("detail", "") for b in breaches}
+            for name in fresh:
+                metrics.record_slo_breach(name)
+                # decision-audit stream: breach EDGES only, like the
+                # counter — reconciles spent in breach aggregate via the
+                # log's dedup ring, not via fresh emissions
+                from . import events as events_mod
+
+                events_mod.emit(
+                    events_mod.EVENT_SLO_BREACHED,
+                    name,
+                    events_mod.FLEET_TARGET,
+                    detail_by_name.get(name, ""),
+                )
             metrics.publish_slo_gauges(
                 phase_quantiles={
                     (phase, q): stat[q]

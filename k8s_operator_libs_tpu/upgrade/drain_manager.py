@@ -30,7 +30,7 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Protocol, Tuple
 
 from .. import metrics
-from ..obs import tracing
+from ..obs import events as events_mod, tracing
 from ..api.upgrade_spec import DrainSpec
 from ..cluster.errors import NotFoundError, TooManyRequestsError
 from ..cluster.client import ClusterClient
@@ -496,6 +496,12 @@ class DrainManager:
                     f"Failed to drain node: {err}",
                 )
                 span.set_status("error", str(err))
+                events_mod.emit(
+                    events_mod.EVENT_NODE_DRAIN_FAILED,
+                    "drain-error",
+                    name,
+                    str(err),
+                )
                 metrics.record_drain(
                     "failed", time.monotonic() - started,
                     trace_id=span.trace_id,
@@ -504,6 +510,10 @@ class DrainManager:
                 return
             metrics.record_drain(
                 "ok", time.monotonic() - started, trace_id=span.trace_id
+            )
+            events_mod.emit(
+                events_mod.EVENT_NODE_DRAINED, "ok", name,
+                "node drained successfully",
             )
             log_event(
                 self._recorder,

@@ -64,6 +64,7 @@ from ..cluster.objects import (
     namespace_of,
     owner_references,
 )
+from ..obs import events as events_mod
 from ..tpu import health, topology
 from . import consts, util
 from .common_manager import ClusterUpgradeState, CommonUpgradeManager
@@ -280,6 +281,12 @@ class RemediationManager:
                 ),
             }
             metrics.record_breaker_trip()
+            events_mod.emit(
+                events_mod.EVENT_BREAKER_TRIPPED,
+                "failure-budget",
+                events_mod.FLEET_TARGET,
+                breaker["reason"],
+            )
             log_event(
                 self._recorder,
                 util.get_component_name(),
@@ -392,6 +399,13 @@ class RemediationManager:
                     self._provider.change_node_upgrade_annotation(
                         node, failure_target_key, target
                     )
+                events_mod.emit(
+                    events_mod.EVENT_NODE_UPGRADE_FAILED,
+                    "attempt-failed",
+                    name_of(node),
+                    f"attempt {attempts} failed"
+                    + (f" (revision {target})" if target else ""),
+                )
                 log_event(
                     self._recorder,
                     name_of(node),
@@ -439,6 +453,12 @@ class RemediationManager:
                 self._provider.change_node_upgrade_annotation(
                     node, failure_at_key, consts.NULL_STRING
                 )
+                events_mod.emit(
+                    events_mod.EVENT_NODE_RETRIED,
+                    "resync",
+                    name_of(node),
+                    f"re-entered the wave ({attempt_label})",
+                )
                 log_event(
                     self._recorder,
                     name_of(node),
@@ -477,6 +497,12 @@ class RemediationManager:
                     continue
                 self._provider.change_node_upgrade_annotation(
                     node, failure_at_key, consts.NULL_STRING
+                )
+                events_mod.emit(
+                    events_mod.EVENT_NODE_RETRIED,
+                    "pod-replace",
+                    name_of(node),
+                    f"replaced failing driver pod ({attempt_label})",
                 )
                 log_event(
                     self._recorder,
@@ -546,6 +572,13 @@ class RemediationManager:
                 self._provider.change_node_upgrade_annotation(
                     node, initial_key, consts.NULL_STRING
                 )
+            events_mod.emit(
+                events_mod.EVENT_NODE_UNADMITTED,
+                events_mod.REASON_ROLLBACK_OVERTOOK,
+                name_of(node),
+                "pod already at the target revision; returned to done "
+                "without a wave pass",
+            )
             log_event(
                 self._recorder,
                 name_of(node),
@@ -783,6 +816,13 @@ class RemediationManager:
             if self._promote_revision(ds, lkg):
                 reverted = True
                 metrics.record_rollback()
+                events_mod.emit(
+                    events_mod.EVENT_ROLLBACK_STARTED,
+                    "breaker",
+                    events_mod.FLEET_TARGET,
+                    f"DaemonSet {ds_name}: revision {target} -> "
+                    f"last-known-good {lkg}",
+                )
                 log_event(
                     self._recorder,
                     util.get_component_name(),
@@ -892,6 +932,12 @@ class RemediationManager:
         )
         self._set_taint(node, add=True)
         metrics.record_node_quarantine()
+        events_mod.emit(
+            events_mod.EVENT_NODE_QUARANTINED,
+            "retry-budget",
+            name_of(node),
+            f"retry budget exhausted (domain {domain})",
+        )
         log_event(
             self._recorder,
             name_of(node),
@@ -938,6 +984,12 @@ class RemediationManager:
                     node, quarantine_key, consts.NULL_STRING
                 )
                 self._set_taint(node, add=False)
+                events_mod.emit(
+                    events_mod.EVENT_QUARANTINE_RELEASED,
+                    "repaired",
+                    name_of(node),
+                    "node repaired and back in sync at the target revision",
+                )
                 log_event(
                     self._recorder,
                     name_of(node),

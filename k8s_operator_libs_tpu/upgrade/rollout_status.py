@@ -163,6 +163,13 @@ class RolloutStatus:
     #: (the live operator's last report, or the ``slo``/``status``
     #: CLI's offline reconstruction).  None = not evaluated.
     slo: Optional[dict] = None
+    #: Recent decision-audit events (obs/events.py dict shape) —
+    #: attached when the caller passes them to
+    #: :meth:`from_cluster_state` (the live log's entries, or the
+    #: offline reconstruction from persisted Event objects).  Feeds the
+    #: last-decisions line and the blocking gate's deferred-node count.
+    #: None = stream not available.
+    decisions: Optional[List[dict]] = None
 
     # ------------------------------------------------------------- derived
     @property
@@ -188,14 +195,16 @@ class RolloutStatus:
     # --------------------------------------------------------- construction
     @classmethod
     def from_cluster_state(
-        cls, state, policy=None, slo_report=None
+        cls, state, policy=None, slo_report=None, decisions=None
     ) -> "RolloutStatus":
         """Compute from a :class:`~.common_manager.ClusterUpgradeState`
         snapshot (the object ``build_state`` returns).  Pass the active
         *policy* to also evaluate the admission gates (canary, window,
         pacing) and explain any freeze; pass an SLO engine report
         (*slo_report*) to surface ETA / stragglers / breaches beside
-        them."""
+        them; pass recent decision events (*decisions*, the
+        obs/events.py dict shape) to cite WHICH nodes a blocking gate
+        defers and render the last-decisions line."""
         census = bucket_census(state)
         domains: Dict[str, DomainStatus] = {}
         for bucket, node_states in state.node_states.items():
@@ -228,6 +237,18 @@ class RolloutStatus:
             status.gates = _evaluate_gates(state, policy)
         if slo_report is not None:
             status.slo = dict(slo_report)
+        if decisions is not None:
+            status.decisions = [dict(d) for d in decisions]
+            # Scope for the gate's deferred-node citation: the decision
+            # stream retains deferrals for nodes that have since been
+            # admitted and finished (live ring and 1h-TTL Events both),
+            # so the count must intersect with what is STILL pending.
+            status._pending_nodes = {
+                ((ns.node.get("metadata") or {}).get("name") or "")
+                for ns in state.nodes_in(
+                    consts.UPGRADE_STATE_UPGRADE_REQUIRED
+                )
+            }
         return status
 
     # ------------------------------------------------------------- derived
@@ -253,7 +274,53 @@ class RolloutStatus:
             out["gates"] = [g.to_dict() for g in self.gates]
         if self.slo is not None:
             out["slo"] = dict(self.slo)
+        if self.decisions is not None:
+            out["decisions"] = [dict(d) for d in self.decisions[-20:]]
         return out
+
+    # ----------------------------------------------------- decision stream
+    def _gate_deferral_note(self, gate: str) -> str:
+        """" (defers N node(s), e.g. nodeX)" for the lead gate line —
+        WHICH nodes a blocking gate holds back, from the decision
+        stream (empty without one: the gate line degrades to the bare
+        reason, exactly the pre-stream rendering).  Scoped to nodes the
+        snapshot still counts as pending — the stream retains deferrals
+        of nodes that have since been admitted and finished, and citing
+        them would let the count exceed the pending counter printed on
+        the same line."""
+        if not self.decisions:
+            return ""
+        from ..obs import events as events_mod
+
+        reasons = set(events_mod.GATE_REASONS.get(gate) or ())
+        if not reasons:
+            return ""
+        pending = getattr(self, "_pending_nodes", None)
+        nodes = sorted(
+            {
+                d.get("target") or ""
+                for d in self.decisions
+                if d.get("type") == events_mod.EVENT_NODE_DEFERRED
+                and d.get("reason") in reasons
+                and d.get("target")
+                and (pending is None or d.get("target") in pending)
+            }
+        )
+        if not nodes:
+            return ""
+        return f" (defers {len(nodes)} node(s), e.g. {nodes[0]})"
+
+    def _decision_lines(self, limit: int = 5) -> List[str]:
+        """The last-decisions block: the newest *limit* entries of the
+        decision stream, oldest first (one shared formatter with the
+        ``events``/``explain`` surfaces)."""
+        if not self.decisions:
+            return []
+        from ..obs.events import format_decision_line
+
+        return [
+            "  " + format_decision_line(d) for d in self.decisions[-limit:]
+        ]
 
     # ---------------------------------------------------------- SLO summary
     def _slo_bits(self) -> List[str]:
@@ -303,7 +370,10 @@ class RolloutStatus:
         blocking = self.blocking_gates
         if lead_gate and blocking and self.pending:
             first = blocking[0]
-            line = f"GATED [{first.gate}]: {first.reason} — " + line
+            line = (
+                f"GATED [{first.gate}]: {first.reason}"
+                f"{self._gate_deferral_note(first.gate)} — " + line
+            )
             if len(blocking) > 1:
                 line += " — also gated: " + "; ".join(
                     g.reason for g in blocking[1:]
@@ -322,7 +392,10 @@ class RolloutStatus:
         blocking = self.blocking_gates
         lines = []
         if blocking:
-            lines.append(f"BLOCKED [{blocking[0].gate}]: {blocking[0].reason}")
+            lines.append(
+                f"BLOCKED [{blocking[0].gate}]: {blocking[0].reason}"
+                + self._gate_deferral_note(blocking[0].gate)
+            )
             lines.append("")
         # counters only — the gate lead above already said WHY
         lines.extend([self.summary(lead_gate=False), ""])
@@ -336,6 +409,11 @@ class RolloutStatus:
             lines.append("rollout SLOs:")
             for bit in bits:
                 lines.append(f"  {bit}")
+            lines.append("")
+        decision_lines = self._decision_lines()
+        if decision_lines:
+            lines.append("last decisions:")
+            lines.extend(decision_lines)
             lines.append("")
         header = (
             f"{'DOMAIN':<28} {'NODES':>5} {'UNAVAIL':>7} {'DEGRADED':>8}  STATES"

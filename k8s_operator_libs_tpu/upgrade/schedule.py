@@ -30,6 +30,7 @@ from typing import Iterable, Optional
 
 from ..api.upgrade_spec import MaintenanceWindowSpec
 from ..cluster.inmem import JsonObj
+from ..obs import events as events_mod
 from . import consts, util
 
 #: Trailing window for admission pacing (seconds).
@@ -151,6 +152,17 @@ def stamp_admission(
     of the same node clears the marker."""
     if now_ts is None:
         now_ts = _time.time()
+    # The decision-audit event rides the stamp itself — every admission
+    # (in-place schedulers AND the requestor handoff) passes through
+    # here, so the stream can never miss one.
+    name = (node.get("metadata") or {}).get("name") or ""
+    events_mod.emit(
+        events_mod.EVENT_NODE_ADMITTED,
+        events_mod.REASON_BYPASS if bypass else events_mod.REASON_FRESH,
+        name,
+        "admitted to cordon-required"
+        + (" (throttle bypass: domain already disrupted)" if bypass else ""),
+    )
     provider.change_node_upgrade_annotation(
         node, util.get_admitted_at_annotation_key(), repr(now_ts)
     )

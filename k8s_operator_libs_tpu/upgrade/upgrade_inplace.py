@@ -26,6 +26,8 @@ from dataclasses import dataclass
 from typing import List, Optional
 
 from ..api.upgrade_spec import UpgradePolicySpec
+from ..cluster.inmem import JsonObj
+from ..obs import events as events_mod
 from ..tpu import topology
 from . import consts, schedule, util
 from .common_manager import ClusterUpgradeState, CommonUpgradeManager, NodeUpgradeState
@@ -35,6 +37,48 @@ logger = logging.getLogger(__name__)
 #: Units whose missing done-at stamp has already been warned about —
 #: the soak-skip degrade-open is logged once per unit, not per census.
 _soak_skip_logged: set = set()
+
+#: Stable deferral messages per reason code — stable on purpose: the
+#: decision log dedups on (type, reason, target), and a per-cycle
+#: varying message would churn the persisted Event's message patch.
+_DEFER_MESSAGES = {
+    events_mod.REASON_BUDGET: "upgrade slot budget exhausted "
+    "(maxParallelUpgrades/maxUnavailable)",
+    events_mod.REASON_WINDOW: "maintenance window closed",
+    events_mod.REASON_PACING: "hourly pacing budget exhausted",
+    events_mod.REASON_CANARY: "canary stage holding admissions",
+    events_mod.REASON_QUARANTINE: "domain quarantined",
+    events_mod.REASON_REMEDIATION: "remediation breaker open",
+    events_mod.REASON_SKIP: "node carries the skip label",
+    events_mod.REASON_SLICE_DOMAIN: "domain larger than maxNodesPerHour "
+    "(can never be admitted under this pacing policy)",
+}
+
+
+def _defer(deferrals: dict, node: JsonObj, reason: str) -> None:
+    """Note one deferral decision — collected per pass (a dict append
+    is all the per-node hot path pays) and bulk-emitted by
+    :func:`_flush_deferrals` so a fully-gated fleet costs one lock +
+    one metrics update per REASON per reconcile, not per node."""
+    deferrals.setdefault(reason, []).append(
+        (node.get("metadata") or {}).get("name") or ""
+    )
+
+
+def _flush_deferrals(log, deferrals: dict) -> int:
+    """Emit the pass's collected deferrals (repeat-identical
+    occurrences aggregate in the log's dedup ring); returns how many
+    nodes were deferred."""
+    total = 0
+    for reason, names in deferrals.items():
+        log.emit_many(
+            events_mod.EVENT_NODE_DEFERRED,
+            reason,
+            names,
+            _DEFER_MESSAGES.get(reason, ""),
+        )
+        total += len(names)
+    return total
 
 
 @dataclass
@@ -256,11 +300,13 @@ class InplaceNodeStateManager:
         # window zeroes the slot budget (bypasses — already-active-domain
         # stragglers, manually cordoned nodes — still finish); pacing caps
         # how many node admissions the trailing hour may add.
+        window_closed = False
         if policy.maintenance_window is not None and not schedule.window_open(
             policy.maintenance_window
         ):
             logger.info("outside maintenance window; no new admissions")
             available = 0
+            window_closed = True
         pacing = schedule.pacing_budget(
             policy, (ns.node for ns in state.all_node_states())
         )
@@ -289,9 +335,10 @@ class InplaceNodeStateManager:
                 remediation.quarantined_domains
             )
 
+        log = events_mod.default_log()
         node_states = state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED)
         if slice_aware:
-            self._schedule_by_domain(
+            admitted, deferred = self._schedule_by_domain(
                 state,
                 node_states,
                 available,
@@ -300,15 +347,31 @@ class InplaceNodeStateManager:
                 pacing_limit=policy.max_nodes_per_hour,
                 canary=canary,
                 remediation_blocked=remediation_blocked,
+                window_closed=window_closed,
+                log=log,
             )
         else:
-            self._schedule_by_node(
+            admitted, deferred = self._schedule_by_node(
                 node_states,
                 available,
                 quarantined,
                 pacing,
                 canary=canary,
                 remediation_blocked=remediation_blocked,
+                window_closed=window_closed,
+                log=log,
+            )
+        if admitted:
+            # One wave-summary decision per admitting pass (repeats
+            # aggregate; the message keeps the latest wave's shape).
+            log.emit(
+                events_mod.EVENT_WAVE_PLANNED,
+                "scheduled",
+                events_mod.FLEET_TARGET,
+                f"admitted {admitted} node(s), deferred {deferred} "
+                f"(slots={available} maxParallel="
+                f"{policy.max_parallel_upgrades} "
+                f"maxUnavailable={max_unavailable})",
             )
 
     def _canary_budget(
@@ -372,15 +435,27 @@ class InplaceNodeStateManager:
         pacing=None,
         canary: Optional[int] = None,
         remediation_blocked: bool = False,
-    ) -> None:
+        window_closed: bool = False,
+        log=None,
+    ) -> tuple:
+        """Returns ``(admitted, deferred)`` node counts for the wave
+        summary; every defer records a reason-coded decision event."""
+        log = log if log is not None else events_mod.default_log()
         common = self._common
+        admitted = 0
+        deferrals: dict = {}
         if remediation_blocked:
             # Node-granular mode has no domain-straggler notion: every
             # admission is fresh version exposure, so a tripped breaker
             # blocks them all.
-            return
+            for node_state in node_states:
+                _defer(
+                    deferrals, node_state.node, events_mod.REASON_REMEDIATION
+                )
+            return 0, _flush_deferrals(log, deferrals)
         for node_state in node_states:
             if not self._prepare(node_state):
+                _defer(deferrals, node_state.node, events_mod.REASON_SKIP)
                 continue
             node = node_state.node
             if quarantined and topology.domain_of(node) in quarantined:
@@ -388,19 +463,30 @@ class InplaceNodeStateManager:
                     "node %s is quarantined (degraded domain), not admitting",
                     (node.get("metadata") or {}).get("name", ""),
                 )
+                _defer(deferrals, node, events_mod.REASON_QUARANTINE)
                 continue
             bypass = common.is_node_unschedulable(node)
             if not bypass:
                 if available <= 0:
                     # Limit reached; only manually-cordoned nodes may
-                    # proceed (reference :87-97).
+                    # proceed (reference :87-97).  The reason code says
+                    # WHICH budget zeroed the slots.
+                    _defer(
+                        deferrals,
+                        node,
+                        events_mod.REASON_WINDOW
+                        if window_closed
+                        else events_mod.REASON_BUDGET,
+                    )
                     continue
                 if pacing is not None and pacing <= 0:
+                    _defer(deferrals, node, events_mod.REASON_PACING)
                     continue  # hourly pacing budget spent
             # The canary budget caps VERSION exposure, so it gates bypass
             # admissions too — a cordoned node adds no new unavailability
             # but still runs the new version.
             if canary is not None and canary <= 0:
+                _defer(deferrals, node, events_mod.REASON_CANARY)
                 continue
             common.provider.change_node_upgrade_state(
                 node, consts.UPGRADE_STATE_CORDON_REQUIRED
@@ -413,11 +499,13 @@ class InplaceNodeStateManager:
             # still decrements unconditionally (reference behavior,
             # :87-97).
             schedule.stamp_admission(common.provider, node, bypass=bypass)
+            admitted += 1
             if not bypass and pacing is not None:
                 pacing -= 1
             if canary is not None:
                 canary -= 1
             available -= 1
+        return admitted, _flush_deferrals(log, deferrals)
 
     def _schedule_by_domain(
         self,
@@ -429,9 +517,13 @@ class InplaceNodeStateManager:
         pacing_limit: int = 0,
         canary: Optional[int] = None,
         remediation_blocked: bool = False,
-    ) -> None:
+        window_closed: bool = False,
+        log=None,
+    ) -> tuple:
         """Slice-aware scheduling: one slot = one domain; all of a chosen
-        domain's upgrade-required nodes advance together.
+        domain's upgrade-required nodes advance together.  Returns
+        ``(admitted, deferred)`` node counts; every deferred node
+        records a reason-coded decision event.
 
         A domain with peers already in an active upgrade state admits its
         upgrade-required stragglers WITHOUT consuming a slot — the domain
@@ -444,14 +536,27 @@ class InplaceNodeStateManager:
         active half pins the only slot, and in slice-coherent safe-load
         mode it is parked at the barrier waiting for the very peer the
         throttle would otherwise never admit."""
+        log = log if log is not None else events_mod.default_log()
         common = self._common
+        admitted = 0
+        deferrals: dict = {}
+
+        def defer_domain(nodes, reason) -> None:
+            for node in nodes:
+                _defer(deferrals, node, reason)
+
         active_domains = {
             topology.domain_of(ns.node)
             for bucket, nss in state.node_states.items()
             if bucket in consts.ACTIVE_STATES
             for ns in nss
         }
-        eligible = [ns for ns in node_states if self._prepare(ns)]
+        eligible = []
+        for ns in node_states:
+            if self._prepare(ns):
+                eligible.append(ns)
+            else:
+                _defer(deferrals, ns.node, events_mod.REASON_SKIP)
         domains = topology.group_by_domain([ns.node for ns in eligible])
         for domain, nodes in domains.items():
             bypass = domain in active_domains or any(
@@ -468,13 +573,21 @@ class InplaceNodeStateManager:
                     "domain %s is quarantined (degraded host), not admitting",
                     domain,
                 )
+                defer_domain(nodes, events_mod.REASON_QUARANTINE)
                 continue
             # Tripped breaker: no FRESH version exposure; active-domain
             # stragglers still finish (same principle as quarantine).
             if remediation_blocked and fresh:
+                defer_domain(nodes, events_mod.REASON_REMEDIATION)
                 continue
             if not bypass:
                 if available <= 0:
+                    defer_domain(
+                        nodes,
+                        events_mod.REASON_WINDOW
+                        if window_closed
+                        else events_mod.REASON_BUDGET,
+                    )
                     continue
                 # pacing counts NODES: the whole domain co-schedules, so
                 # it must fit in the remaining hourly budget (stragglers
@@ -493,11 +606,15 @@ class InplaceNodeStateManager:
                             len(nodes),
                             pacing_limit,
                         )
+                        defer_domain(nodes, events_mod.REASON_SLICE_DOMAIN)
+                    else:
+                        defer_domain(nodes, events_mod.REASON_PACING)
                     continue
             # The canary budget caps VERSION exposure: every fresh domain
             # — including cordoned-node bypasses — consumes it; active-
             # domain stragglers are already counted via their stamp.
             if canary is not None and fresh and canary <= 0:
+                defer_domain(nodes, events_mod.REASON_CANARY)
                 continue
             for node in nodes:
                 common.provider.change_node_upgrade_state(
@@ -506,12 +623,14 @@ class InplaceNodeStateManager:
                 # bypass admissions stamped too (canary census), with the
                 # pacing-exempt marker — see _schedule_by_node
                 schedule.stamp_admission(common.provider, node, bypass=bypass)
+                admitted += 1
             if canary is not None and fresh:
                 canary -= 1
             if not bypass:
                 available -= 1
                 if pacing is not None:
                     pacing -= len(nodes)
+        return admitted, _flush_deferrals(log, deferrals)
 
     # ------------------------------------------------- node-maintenance (n/a)
     def process_node_maintenance_required_nodes(

@@ -27,6 +27,7 @@ from contextlib import nullcontext
 from typing import Dict, List, Optional, Tuple
 
 from .. import metrics
+from ..obs import events as events_mod
 from ..obs import slo as slo_mod
 from ..obs import tracing
 from ..api.upgrade_spec import UpgradePolicySpec
@@ -78,6 +79,9 @@ class ClusterUpgradeStateManager:
         use_state_index: bool = False,
         state_index: Optional[ClusterStateIndex] = None,
         flight_recorder: Optional[timeline_mod.FlightRecorder] = None,
+        decision_event_sink: Optional[
+            "events_mod.ClusterDecisionEventSink"
+        ] = None,
         # test injection points (the reference wires mocks the same way,
         # upgrade_suit_test.go:114-182)
         provider: Optional[NodeUpgradeStateProvider] = None,
@@ -207,6 +211,17 @@ class ClusterUpgradeStateManager:
         self._remediation = RemediationManager(
             cluster, self._provider, recorder
         )
+        #: Optional persistence of the decision-event stream as real
+        #: core/v1 Events (obs/events.py); pumped once per ApplyState
+        #: pass — O(changed) — when wired.  None = in-memory log only.
+        self._decision_event_sink = decision_event_sink
+        #: Freshest (snapshot, policy) the explain plane answers from;
+        #: set by every apply_state pass.  Reads from the ops-server
+        #: thread may observe a mid-pass snapshot — explain is a
+        #: diagnostic read, staleness of one pass is acceptable by
+        #: contract (same stance as /debug/slo).
+        self._last_state: Optional[ClusterUpgradeState] = None
+        self._last_policy: Optional[UpgradePolicySpec] = None
 
     def shutdown(self, wait: bool = True) -> None:
         """Release the worker-pool threads this manager owns.  Long-lived
@@ -337,6 +352,30 @@ class ClusterUpgradeStateManager:
         """The flight recorder's snapshot — the ``OpsServer GET
         /debug/timeline`` payload (*node* filters at the source)."""
         return self.flight_recorder.snapshot(node)
+
+    # -------------------------------------------------- decision-audit plane
+    def events_status(self) -> dict:
+        """The decision-event log's snapshot — the ``OpsServer GET
+        /debug/events`` payload (the server applies ?node=/?type=/
+        ?limit= filters)."""
+        return events_mod.default_log().snapshot()
+
+    def explain_node(self, node: str) -> Optional[dict]:
+        """"Why is node X not progressing" — the ``OpsServer GET
+        /debug/explain?node=`` payload and the live half of the
+        ``explain`` CLI.  None before the first apply_state pass or for
+        a node the last snapshot does not manage (the server 404s)."""
+        state = self._last_state
+        if state is None or not node:
+            return None
+        return events_mod.explain_node(
+            node,
+            state,
+            policy=self._last_policy,
+            recorder=self.flight_recorder,
+            slo_report=self.slo_status(),
+            decisions=events_mod.default_log().events(),
+        )
 
     # ------------------------------------------------------------ BuildState
     @property
@@ -552,6 +591,9 @@ class ClusterUpgradeStateManager:
         self.last_apply_transitions = 0
         if state is None:
             raise UpgradeStateError("currentState should not be empty")
+        # The explain plane answers from the freshest processed snapshot.
+        self._last_state = state
+        self._last_policy = policy
         if policy is None or policy.remediation is None:
             # Engine off (block removed / CR deleted): retire the stale
             # decision so gauges and /debug/remediation don't keep
@@ -583,6 +625,7 @@ class ClusterUpgradeStateManager:
             # dirty view, so the index keeps it as scan debt and the
             # scoped scans revisit those nodes once the rollout resumes.
             logger.info("auto upgrade is disabled, skipping")
+            self._pump_decision_events()
             return
         if getattr(self._safe_load_manager, "slice_coherent", False):
             # Not a preference: the coherence barrier is only deadlock-free
@@ -629,6 +672,17 @@ class ClusterUpgradeStateManager:
                     "apply", time.monotonic() - started,
                     trace_id=span.trace_id,
                 )
+                # finally too: the decisions an ABORTED pass already made
+                # (admissions, a breaker trip) are exactly what the audit
+                # stream must not lose.  One pump per pass = O(changed).
+                self._pump_decision_events()
+
+    def _pump_decision_events(self) -> None:
+        """Flush this pass's decision events to the cluster sink (when
+        wired).  The sink's own error envelope already guarantees a
+        persistence failure never breaks a rollout."""
+        if self._decision_event_sink is not None:
+            self._decision_event_sink.pump()
 
     def _restore_policy_defaults(self) -> None:
         """Undo every policy-pushed override (topology keys, cache-sync
@@ -929,6 +983,17 @@ class ClusterUpgradeStateManager:
                 # requestor processors, which keep running.
                 logger.info(
                     "remediation breaker open; no new requestor handoffs"
+                )
+                events_mod.default_log().emit_many(
+                    events_mod.EVENT_NODE_DEFERRED,
+                    events_mod.REASON_REMEDIATION,
+                    [
+                        (ns.node.get("metadata") or {}).get("name") or ""
+                        for ns in state.nodes_in(
+                            consts.UPGRADE_STATE_UPGRADE_REQUIRED
+                        )
+                    ],
+                    "remediation breaker open (requestor handoff paused)",
                 )
                 return
             self._requestor.process_upgrade_required_nodes(state, policy)

@@ -77,6 +77,19 @@ def fresh_flight_recorder():
 
 
 @pytest.fixture(autouse=True)
+def fresh_decision_log():
+    """Per-test decision-event-log isolation: the default log is
+    process-global (like the tracer/recorder); a fresh one per test
+    keeps decision streams from leaking across tests while the
+    always-on emission hooks stay exercised everywhere."""
+    from k8s_operator_libs_tpu.obs import events
+
+    previous = events.set_default_log(events.DecisionEventLog())
+    yield
+    events.set_default_log(previous)
+
+
+@pytest.fixture(autouse=True)
 def reset_topology_label_keys():
     """Per-policy topology key overrides are process-global (like the
     component name); restore defaults between tests."""

@@ -48,6 +48,7 @@ def stubbed_probes(monkeypatch):
         lambda *a, **k: {
             "timeline_overhead_pct_1024n": 99999.99,
             "slo_eval_ms_1024n": 99999.99,
+            "event_overhead_pct_1024n": 99999.99,
         },
     )
     monkeypatch.setattr(

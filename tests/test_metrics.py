@@ -435,6 +435,8 @@ class TestAlertRulesStayInSync:
                 set(),
             )
             m.record_slo_breach("drainP99Seconds")
+            # decision-audit family (obs/events.py)
+            m.record_upgrade_event("NodeDeferred", "budget")
             # write-pipeline family (async batched write dispatcher)
             m.write_queue_depth_gauge().set(0)
             m.http_inflight_writes_gauge().set(0)
