@@ -23,10 +23,18 @@ informer lag, twice:
   BuildState index, with default-GC and full-rebuild 4,096-node A/Bs
   (``detail.gc_tuning_speedup_4096n``,
   ``detail.state_index_rollout_speedup_4096n``) plus a direct
-  BuildState A/B (``detail.build_state_incremental_speedup``);
+  BuildState A/B (``detail.build_state_incremental_speedup``) and the
+  always-on-plane overhead gates (flight recorder, decision events,
+  sampling profiler — each ≤ 5%, measured with the shared interleaved
+  paired-ratio helper in ``obs/overhead.py``);
   ``python bench.py --profile`` prints a cProfile of the 4,096-node
   probe instead of benchmarking; ``--scale-only`` (``make bench-scale``)
   runs just this section as one compact JSON line;
+* **differential profiles** — the http-vs-in-mem and engine-on/off A/B
+  pairs re-captured under the continuous sampling profiler
+  (``obs/profiling.py``): the tail carries the slow side's top
+  span-attributed self-time frames (``detail.profile_http_top``) and
+  the full artifact the per-frame regressions vs the fast side;
 * **HTTP path** — the same tuned rollout over real localhost HTTP:
   ApiServerFacade with server-enforced 500-item pages + KubeApiClient
   held watch streams (the production read path) and the async batched
@@ -563,6 +571,7 @@ def bench_timeline_slo(
     from k8s_operator_libs_tpu.api import MaintenanceWindowSpec, SloSpec
     from k8s_operator_libs_tpu.obs import events as events_mod
     from k8s_operator_libs_tpu.obs import slo as slo_mod
+    from k8s_operator_libs_tpu.obs.overhead import interleaved_overhead_pct
     from k8s_operator_libs_tpu.upgrade import (
         FlightRecorder,
         consts,
@@ -570,43 +579,6 @@ def bench_timeline_slo(
     )
 
     nodes = slices * hosts
-
-    def interleaved_overhead_pct(run_cycle, set_side, pairs: int) -> float:
-        """Median per-pair overhead of side True vs side False with the
-        two sides interleaved at CYCLE granularity.  Why: the ≤5% gates
-        these probes feed sit far below this box's noise floor — CPU
-        speed itself drifts ±15% over seconds (steal/frequency), so two
-        monolithic A/B runs minutes apart cannot resolve a 2% signal.
-        Adjacent cycles DO share the box's momentary speed, so each
-        pair's ratio is clean, and the median sheds scheduler spikes.
-        Two further confounds handled here: side order is RANDOMIZED
-        per pair (a deterministic A/B/B/A pattern aliased with the
-        collector's periodic gen-2 spikes, pinning +35%/-25% biases on
-        one side), and a full gc.collect() runs before each pair so no
-        aged collection lands inside a timed window."""
-        import gc
-        import random
-
-        rng = random.Random(0x5eed)
-        ratios = []
-        for _ in range(pairs):
-            sides = (False, True) if rng.random() < 0.5 else (True, False)
-            gc.collect()
-            sample = {}
-            for enabled in sides:
-                set_side(enabled)
-                t0 = time.perf_counter()
-                run_cycle()
-                sample[enabled] = time.perf_counter() - t0
-            ratios.append(sample[True] / max(sample[False], 1e-9))
-        ratios.sort()
-        # interquartile mean: averages the central half of the pair
-        # ratios — keeps the median's outlier immunity while using 15
-        # samples instead of 2, which is what holds run-to-run spread
-        # inside a ±1% band around the true overhead
-        lo, hi = len(ratios) // 4, len(ratios) - len(ratios) // 4
-        middle = ratios[lo:hi]
-        return (sum(middle) / len(middle) - 1) * 100
 
     # ---- timeline overhead: a steady fleet, one node touched per cycle
     cluster = InMemoryCluster()
@@ -768,6 +740,169 @@ def bench_timeline_slo(
     }
 
 
+def bench_profile_overhead(
+    policy: UpgradePolicySpec, slices: int = 256, hosts: int = 4,
+    cycles: int = 30,
+) -> dict:
+    """Continuous-profiler cost at 1,024 nodes
+    (``profile_overhead_pct_1024n``, acceptance: <= 5% — the same gate
+    as the flight recorder and decision events): BuildState+ApplyState
+    on a steady fleet with the sampler running+span-attributing vs
+    stopped, measured with the shared interleaved paired-ratio
+    methodology (obs/overhead.py)."""
+    from k8s_operator_libs_tpu.obs import profiling as profiling_mod
+    from k8s_operator_libs_tpu.obs.overhead import interleaved_overhead_pct
+
+    nodes = slices * hosts
+    cluster = InMemoryCluster()
+    fleet = Fleet(cluster, revision_hash="rev1")
+    for s in range(slices):
+        for h in range(hosts):
+            fleet.add_node(f"p{s:03d}-h{h}")
+    manager = ClusterUpgradeStateManager(
+        cluster,
+        cache=InformerCache(cluster, lag_seconds=0.0),
+        cache_sync_timeout_seconds=5.0,
+        cache_sync_poll_seconds=0.005,
+    )
+    profiler = profiling_mod.SamplingProfiler()
+    touch = {"i": 0}
+
+    def set_side(enabled: bool) -> None:
+        # pause switch, not start/stop: per-pair thread churn bills the
+        # spawn's allocations/GC to the "on" cycle (~10% phantom for a
+        # real ~1%); with the thread alive on both sides the A/B
+        # isolates the sampling work + span-observer hook themselves,
+        # and the off side still pays the (negligible) idle wakeups
+        profiler.enabled = enabled
+        if enabled:
+            profiler.install()
+        else:
+            profiler.uninstall()
+
+    try:
+        profiler.start()
+        # settle: every node classifies unknown -> done, so the timed
+        # cycles measure the steady-state reconcile the operator runs
+        # 24/7 — the regime an always-on profiler must not tax.  Six
+        # cycles, not three: a cold process's first fleet-scale cycles
+        # are reliably outliers (allocator/arena growth — the scale
+        # probes burn a whole warm-up rollout for the same reason), and
+        # a warm-up trend inside the pairs biased this probe +10%.
+        for _ in range(6):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+
+        def steady_pair_side() -> None:
+            # TWO cycles per timed side: this fleet's steady cycle has
+            # heavy-tailed wall noise (periodic journal/informer
+            # housekeeping lands on random cycles, ±40% pair ratios);
+            # two cycles halve a single tail's leverage on the ratio
+            for _ in range(2):
+                touch["i"] += 1
+                cluster.patch(
+                    "Node",
+                    "p000-h0",
+                    {
+                        "metadata": {
+                            "annotations": {"bench/touch": str(touch["i"])}
+                        }
+                    },
+                )
+                state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+                manager.apply_state(state, policy)
+
+        overhead_pct = interleaved_overhead_pct(
+            steady_pair_side, set_side, pairs=max(8, cycles)
+        )
+    finally:
+        profiler.stop()
+        profiler.uninstall()
+        manager.shutdown()
+    return {f"profile_overhead_pct_{nodes}n": round(overhead_pct, 2)}
+
+
+def _profiled(run_fn):
+    """Run *run_fn* under a private high-rate sampling profiler with
+    span attribution installed; returns ``(result, snapshot)`` — the
+    differential-profiling capture both A/B pairs share."""
+    from k8s_operator_libs_tpu.obs import profiling as profiling_mod
+
+    profiler = profiling_mod.SamplingProfiler(hz=199.0, window_seconds=120.0)
+    profiler.install()
+    profiler.start()
+    try:
+        result = run_fn()
+    finally:
+        profiler.stop()
+        profiler.uninstall()
+    return result, profiler.snapshot()
+
+
+def _top_frames_dict(snapshot: dict, n: int = 3) -> dict:
+    """The top SPAN-ATTRIBUTED self-time frames as ``{frame: pct}`` — a
+    dict of numbers so the compact tail keeps it (prose strings and
+    lists are pruned), frame labels trimmed from the LEFT (the leaf
+    half is the signal).  Span-attributed, so parked pool workers
+    (threading.wait forever) don't drown the rollout's real frames."""
+    from k8s_operator_libs_tpu.obs import profiling as profiling_mod
+
+    out: dict = {}
+    for frame, share in profiling_mod.top_span_frames(snapshot, n=n):
+        # summed on suffix collision — last-write-wins would silently
+        # drop a colliding frame's share from the published tail
+        key = frame[-32:]
+        out[key] = round(out.get(key, 0.0) + 100.0 * share, 1)
+    return out
+
+
+def bench_differential_profiles(tuned_policy: UpgradePolicySpec) -> dict:
+    """Differential profiling over the two standing A/B probes: the
+    SAME 48-node lagged rollout captured under the sampler on (a) the
+    HTTP transport vs in-mem and (b) the full engine vs all
+    optimizations off — so the tail's ratios come WITH the top
+    self-time frames of each slow side (an attributed frame list, not
+    just a number) plus the top regressing frames vs the fast side."""
+    from k8s_operator_libs_tpu.obs import profiling as profiling_mod
+
+    (inmem_s, inmem_snap) = _profiled(
+        lambda: run_rollout(tuned_policy, cascade=True)
+    )
+    ((http_s, _req), http_snap) = _profiled(
+        lambda: run_rollout_http(tuned_policy)
+    )
+    (all_off_s, all_off_snap) = _profiled(
+        lambda: run_rollout(
+            tuned_policy, deferred_visibility=False, use_indexes=False
+        )
+    )
+    diff_http = profiling_mod.diff_collapsed(
+        profiling_mod.merged_stacks(inmem_snap),
+        profiling_mod.merged_stacks(http_snap),
+        top=5,
+    )
+    diff_engine = profiling_mod.diff_collapsed(
+        profiling_mod.merged_stacks(inmem_snap),
+        profiling_mod.merged_stacks(all_off_snap),
+        top=5,
+    )
+    return {
+        # the slow sides' attributed frame lists (compact-tail safe:
+        # dicts of numbers survive the prune; the *_regressing lists
+        # ride only in the full artifact)
+        "profile_http_top": _top_frames_dict(http_snap),
+        "profile_engine_off_top": _top_frames_dict(all_off_snap),
+        "profile_inmem_top": _top_frames_dict(inmem_snap),
+        "profile_http_regressing": diff_http,
+        "profile_engine_off_regressing": diff_engine,
+        "profile_pair_walls_s": {
+            "inmem": round(inmem_s, 2),
+            "http": round(http_s, 2),
+            "all_off": round(all_off_s, 2),
+        },
+    }
+
+
 def scale_section(tuned_policy: UpgradePolicySpec) -> dict:
     """Fleet-scale probes: tuned config over 1,024 / 4,096 / 8,192 /
     16,384 nodes, no injected informer lag — the control plane's own
@@ -819,6 +954,7 @@ def scale_section(tuned_policy: UpgradePolicySpec) -> dict:
     return {
         **bench_build_state_ab(),
         **bench_timeline_slo(tuned_policy),
+        **bench_profile_overhead(tuned_policy),
         "state_index_rollout_speedup_4096n": round(
             scale_4k_fullbuild_s / scale_4k_s, 3
         ),
@@ -1006,6 +1142,11 @@ def main() -> None:
     # ---- remediation: breaker-trip → LKG-rollback MTTR at 1,024 nodes
     remediation = remediation_section()
 
+    # ---- differential profiling: the standing A/B pairs re-captured
+    # under the sampler, so the transport/engine ratios come with the
+    # slow side's top self-time frames attached (obs/profiling.py)
+    profiles = bench_differential_profiles(tuned_policy)
+
     # ---- HTTP path: the production loop over real localhost HTTP with
     # server-enforced pages and held watch streams — the 48-node lagged
     # fleet (20-item pages, r4 continuity) AND the 1,024-node probe
@@ -1118,6 +1259,7 @@ def main() -> None:
                     "http_vs_inmem_ceiling_1024n": round(
                         scale["scale_1024_nodes_per_min"] / http_1k_rate, 3
                     ),
+                    **profiles,
                     "http_scale_gap": (
                         "http_vs_inmem_1024n is the controlled A/B: "
                         "identical engine + informer lag both sides, "
@@ -1148,6 +1290,40 @@ def main() -> None:
 #: Ceiling for the compact result line — comfortably inside the
 #: driver's observed 2000-char stdout-tail window.
 COMPACT_LINE_BUDGET = 1900
+
+#: Detail keys shed FIRST (in order) when the compact line outgrows the
+#: budget — auxiliary numbers a reader can derive or live without:
+#: wall-clock twins of the nodes/min rates, the fast side's profile
+#: frames, request rates.  ``engine.x`` addresses a nested key.  The
+#: full (pretty) artifact always keeps everything; only the compact
+#: tail sheds — and only under pressure, so a lean round still carries
+#: the walls.  The last-resort end-shedding guard stays behind this,
+#: but with this list sized right it never reaches the tracked keys OR
+#: the tpu/compute_cpu evidence sections at the back.
+COMPACT_SHED_FIRST = (
+    "profile_pair_walls_s",
+    "profile_inmem_top",
+    "engine.idx_on_512n_wall_s",
+    "engine.idx_off_512n_wall_s",
+    "engine.no_cascade_wall_s",
+    "engine.no_defer_wall_s",
+    "engine.all_off_wall_s",
+    "engine.full_wall_s",
+    "scale_1024_wall_s",
+    "scale_4096_wall_s",
+    "scale_8192_wall_s",
+    "scale_16384_wall_s",
+    "http_wall_s",
+    "http_scale_1024_wall_s",
+    "http_requests_per_s",
+    "http_scale_1024_requests_per_s",
+    "baseline_wall_s",
+    "tuned_wall_s",
+    "scale_4096_full_build_nodes_per_min",
+    "scale_4096_default_gc_nodes_per_min",
+    "profile_engine_off_top",
+    "fleet",
+)
 
 
 def compact_result(result: dict) -> dict:
@@ -1193,13 +1369,28 @@ def compact_result(result: dict) -> dict:
             slim = prune(slim_measurement(result["detail"].get(section)))
             if slim:
                 detail[section] = slim
-        # shed lowest-priority keys (insertion order: headline numbers
-        # were added first) until the line fits
-        while (
-            len(json.dumps(compact, separators=(",", ":")))
-            > COMPACT_LINE_BUDGET
-            and detail
-        ):
+
+        def over_budget() -> bool:
+            return (
+                len(json.dumps(compact, separators=(",", ":")))
+                > COMPACT_LINE_BUDGET
+            )
+
+        # first shed the declared-auxiliary keys, in priority order
+        for dotted in COMPACT_SHED_FIRST:
+            if not over_budget():
+                break
+            target = detail
+            *path, leaf = dotted.split(".")
+            for part in path:
+                target = target.get(part) if isinstance(target, dict) else None
+                if target is None:
+                    break
+            if isinstance(target, dict):
+                target.pop(leaf, None)
+        # last resort: shed whole keys from the END (insertion order:
+        # headline numbers were added first) until the line fits
+        while over_budget() and detail:
             detail.pop(next(reversed(detail)))
     return compact
 

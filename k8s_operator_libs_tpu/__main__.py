@@ -39,6 +39,17 @@ Subcommands:
       python -m k8s_operator_libs_tpu explain --state-file dump.json --node n17
       python -m k8s_operator_libs_tpu events --kubeconfig --json
       python -m k8s_operator_libs_tpu explain --selftest   # make verify-events
+
+* ``profile`` — the continuous profiling plane (:mod:`.obs.profiling`):
+  live-capture a window from the operator's ``/debug/profile``
+  endpoint, render a saved dump (span self-time table + top frames,
+  collapsed stacks, or speedscope JSON), and diff two dumps for the
+  top regressing frames.
+
+      python -m k8s_operator_libs_tpu profile --url http://op:8080 --seconds 5
+      python -m k8s_operator_libs_tpu profile --file profile.json --fmt collapsed
+      python -m k8s_operator_libs_tpu profile diff before.txt after.txt
+      python -m k8s_operator_libs_tpu profile --selftest   # make verify-profile
 """
 
 from __future__ import annotations
@@ -719,6 +730,148 @@ def cmd_events(args: argparse.Namespace) -> int:
     return 0
 
 
+def _load_profile_dump(path: str):
+    """A profile dump from disk: native/speedscope JSON or collapsed
+    text, normalized to ``(snapshot_dict, collapsed_counts)``.  Raises
+    the same exception families the other offline loaders map to exit
+    code 2."""
+    from .obs import profiling
+
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        payload = json.loads(text)
+    except json.JSONDecodeError:
+        counts = profiling.parse_collapsed(text)  # ValueError when neither
+        snapshot = {
+            "running": False,
+            "windows": [
+                {
+                    "started_unix": 0.0,
+                    "samples": sum(counts.values()),
+                    "stacks": counts,
+                    "span_self": {},
+                    "span_total": {},
+                    "span_frames": {},
+                }
+            ],
+        }
+        return snapshot, counts
+    snapshot = profiling.snapshot_from_payload(payload)
+    return snapshot, profiling.merged_stacks(snapshot)
+
+
+def cmd_profile(args: argparse.Namespace) -> int:
+    """The profiling plane's CLI: ``--selftest`` (the ``make
+    verify-profile`` gate), ``diff A B`` (top regressing frames between
+    two dumps), offline rendering of a saved ``/debug/profile`` dump,
+    or live capture from a running operator's endpoint."""
+    from .obs import profiling
+
+    if args.selftest:
+        try:
+            print(profiling.selftest())
+        except AssertionError as err:
+            print(f"profile selftest FAILED: {err}", file=sys.stderr)
+            return 1
+        return 0
+
+    # ---- `profile diff OLD NEW`: the differential workflow
+    if args.paths:
+        if args.paths[0] != "diff" or len(args.paths) != 3:
+            print(
+                "usage: profile diff OLD NEW   (two saved dumps: native/"
+                "speedscope JSON or collapsed text)",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            _, old_counts = _load_profile_dump(args.paths[1])
+            _, new_counts = _load_profile_dump(args.paths[2])
+        except FileNotFoundError as err:
+            print(f"profile dump not found: {err.filename}", file=sys.stderr)
+            return 2
+        except OSError as err:
+            print(f"cannot read profile dump: {err}", file=sys.stderr)
+            return 2
+        except (ValueError, TypeError, KeyError) as err:
+            print(f"not a profile dump: {err}", file=sys.stderr)
+            return 2
+        regressions = profiling.diff_collapsed(
+            old_counts, new_counts, top=args.top
+        )
+        if args.json:
+            print(json.dumps(regressions))
+            return 0
+        if not regressions:
+            print("no frames in either dump")
+            return 0
+        print(f"{'delta':>8} {'old':>7} {'new':>7}  frame  (+ = slower in NEW)")
+        for entry in regressions:
+            print(
+                f"{entry['delta_pct']:+7.2f}p {entry['old_pct']:6.2f}% "
+                f"{entry['new_pct']:6.2f}%  {entry['frame']}"
+            )
+        return 0
+
+    # ---- resolve ONE snapshot source: --file | --url
+    if args.file and args.url:
+        print(
+            "profile takes ONE source: --file DUMP or --url BASE, not both",
+            file=sys.stderr,
+        )
+        return 2
+    if args.file:
+        try:
+            snapshot, _ = _load_profile_dump(args.file)
+        except FileNotFoundError:
+            print(f"profile dump not found: {args.file}", file=sys.stderr)
+            return 2
+        except OSError as err:
+            print(f"cannot read profile dump {args.file}: {err}", file=sys.stderr)
+            return 2
+        except (ValueError, TypeError, KeyError) as err:
+            print(
+                f"profile dump {args.file} is not a profile dump: {err}",
+                file=sys.stderr,
+            )
+            return 2
+    elif args.url:
+        import urllib.error
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/debug/profile"
+        if args.seconds:
+            url += f"?seconds={args.seconds:g}"
+        try:
+            with urllib.request.urlopen(
+                url, timeout=max(30.0, args.seconds + 30.0)
+            ) as resp:
+                snapshot = profiling.snapshot_from_payload(
+                    json.loads(resp.read().decode())
+                )
+        except (urllib.error.URLError, OSError, ValueError) as err:
+            print(f"cannot capture from {url}: {err}", file=sys.stderr)
+            return 2
+    else:
+        print(
+            "profile needs a source: --file DUMP, --url BASE "
+            "(or `diff OLD NEW` / --selftest)",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.fmt == "collapsed":
+        sys.stdout.write(profiling.to_collapsed(snapshot))
+    elif args.fmt == "speedscope":
+        print(json.dumps(profiling.to_speedscope(snapshot)))
+    elif args.json:
+        print(json.dumps(snapshot))
+    else:
+        print(profiling.render_report(snapshot, top=args.top))
+    return 0
+
+
 def cmd_repair(args: argparse.Namespace) -> int:
     """Codify the upgrade-failed runbook: delete a failed node's driver
     pod so the DaemonSet recreates it at the target revision and the
@@ -1105,6 +1258,69 @@ def main(argv=None) -> int:
         help="same end-to-end smoke as `explain --selftest`",
     )
     ev.set_defaults(func=cmd_events)
+
+    pf = sub.add_parser(
+        "profile",
+        help="continuous-profiling plane: live-capture from a running "
+        "operator's /debug/profile, render a saved dump (span self-time "
+        "table + top frames / collapsed stacks / speedscope JSON), or "
+        "`profile diff OLD NEW` for the top regressing frames; "
+        "--selftest smokes the pipeline end-to-end",
+    )
+    pf.add_argument(
+        "paths",
+        nargs="*",
+        metavar="diff OLD NEW",
+        help="diff mode: compare two saved dumps (native/speedscope "
+        "JSON or collapsed text) and print the top regressing frames",
+    )
+    pf.add_argument(
+        "--file",
+        default="",
+        help="saved profile dump to render (any shape /debug/profile or "
+        "this CLI emits: native JSON, speedscope JSON, collapsed text)",
+    )
+    pf.add_argument(
+        "--url",
+        default="",
+        help="live capture: base URL of a running operator's OpsServer "
+        "(e.g. http://127.0.0.1:8080); fetches /debug/profile",
+    )
+    pf.add_argument(
+        "--seconds",
+        type=float,
+        default=0.0,
+        help="with --url: block for an on-demand capture window of this "
+        "many seconds instead of reading the continuous ring",
+    )
+    pf.add_argument(
+        "--fmt",
+        choices=("report", "collapsed", "speedscope"),
+        default="report",
+        help="output: human report (span self/child table + top "
+        "self-time frames, default), collapsed stacks (flamegraph.pl / "
+        "speedscope importable), or speedscope.app JSON",
+    )
+    pf.add_argument(
+        "--top",
+        type=int,
+        default=10,
+        help="rows in the top-frames / diff tables",
+    )
+    pf.add_argument(
+        "--json",
+        action="store_true",
+        help="machine output (native snapshot; with --fmt speedscope "
+        "the output is already JSON)",
+    )
+    pf.add_argument(
+        "--selftest",
+        action="store_true",
+        help="run the sampler → span attribution → /debug/profile → "
+        "diff smoke end-to-end and exit 0/1 — the make verify-profile "
+        "gate (no source needed)",
+    )
+    pf.set_defaults(func=cmd_profile)
 
     rp = sub.add_parser(
         "repair",

@@ -19,6 +19,13 @@ server exposing
   process tracer (:mod:`..obs.tracing`), OTLP-flavoured JSON by default;
   ``?fmt=chrome`` renders ``chrome://tracing`` JSON, ``?fmt=native`` the
   raw span dicts, ``?trace_id=...`` filters to one trace;
+* ``GET /debug/profile`` — the continuous sampling profiler's window
+  ring (:mod:`..obs.profiling`), native JSON by default;
+  ``?fmt=collapsed`` serves flamegraph.pl/speedscope-importable
+  collapsed stacks as text, ``?fmt=speedscope`` the speedscope.app
+  JSON; ``?seconds=N`` blocks for an on-demand capture window (capped
+  at 60 s) instead of the ring; ``?windows=N`` keeps the newest N;
+  ``?heap=1`` adds the tracemalloc allocation snapshot (native only);
 * ``GET /debug/remediation`` — the remediation engine's latest decision
   (breaker state, LKG records, quarantines) when a *remediation_source*
   was wired (usually ``manager.remediation_status``); 404 otherwise;
@@ -64,6 +71,7 @@ from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs
 
 from .. import metrics as metrics_mod
+from ..obs import profiling as profiling_mod
 from ..obs import tracing as tracing_mod
 
 logger = logging.getLogger(__name__)
@@ -95,6 +103,7 @@ class OpsServer:
         host: str = "0.0.0.0",
         registry: Optional[metrics_mod.MetricsRegistry] = None,
         tracer: Optional[tracing_mod.Tracer] = None,
+        profiler: Optional[profiling_mod.SamplingProfiler] = None,
         remediation_source: Optional[Callable[[], Optional[dict]]] = None,
         slo_source: Optional[Callable[[], Optional[dict]]] = None,
         timeline_source: Optional[Callable[..., dict]] = None,
@@ -108,6 +117,11 @@ class OpsServer:
         self._requested_port = port
         self._registry = registry
         self._tracer = tracer
+        #: Profiler served at /debug/profile (None = the process
+        #: default, like the tracer — the route is always registered;
+        #: a stopped profiler just serves an empty ring with
+        #: running=false, which is itself the diagnostic).
+        self._profiler = profiler
         #: Callable returning the remediation engine's latest decision
         #: dict (None = no pass yet); absent means the endpoint 404s.
         self._remediation_source = remediation_source
@@ -155,6 +169,7 @@ class OpsServer:
             str, Callable[[Dict[str, list]], Tuple[int, str, bytes]]
         ] = {}
         self._debug_routes["/debug/traces"] = self._render_traces
+        self._debug_routes["/debug/profile"] = self._render_profile
         if remediation_source is not None:
             self._debug_routes["/debug/remediation"] = (
                 self._render_remediation
@@ -240,6 +255,55 @@ class OpsServer:
                 400,
                 "text/plain; charset=utf-8",
                 f"unknown fmt {fmt!r} (want otlp | chrome | native)\n".encode(),
+            )
+        return 200, "application/json", (json.dumps(payload) + "\n").encode()
+
+    def _render_profile(
+        self, query: Dict[str, list]
+    ) -> Tuple[int, str, bytes]:
+        profiler = self._profiler or profiling_mod.default_profiler()
+        raw_seconds = (query.get("seconds") or [""])[0]
+        if raw_seconds:
+            try:
+                seconds = float(raw_seconds)
+            except ValueError:
+                seconds = -1.0
+            if not 0 < seconds <= 60:
+                return (
+                    400,
+                    "text/plain; charset=utf-8",
+                    f"seconds must be in (0, 60], got {raw_seconds!r}\n"
+                    .encode(),
+                )
+            # on-demand window: blocks THIS request thread only (the
+            # server is threading), bounded by the 60 s cap above
+            snapshot = profiler.capture(seconds)
+        else:
+            raw_windows = (query.get("windows") or [""])[0]
+            try:
+                windows = int(raw_windows) if raw_windows else None
+            except ValueError:
+                windows = None
+            snapshot = profiler.snapshot(windows=windows)
+        fmt = (query.get("fmt") or ["native"])[0]
+        if fmt == "collapsed":
+            return (
+                200,
+                "text/plain; charset=utf-8",
+                profiling_mod.to_collapsed(snapshot).encode(),
+            )
+        if fmt == "speedscope":
+            payload = profiling_mod.to_speedscope(snapshot)
+        elif fmt == "native":
+            payload = snapshot
+            if (query.get("heap") or [""])[0] in ("1", "true"):
+                payload = dict(snapshot, heap=profiling_mod.heap_snapshot())
+        else:
+            return (
+                400,
+                "text/plain; charset=utf-8",
+                f"unknown fmt {fmt!r} (want native | collapsed | "
+                f"speedscope)\n".encode(),
             )
         return 200, "application/json", (json.dumps(payload) + "\n").encode()
 

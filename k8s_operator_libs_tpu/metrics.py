@@ -787,6 +787,34 @@ def record_upgrade_event(type_: str, reason: str) -> None:
     upgrade_events_counter().inc(type_ or "unknown", reason or "unknown")
 
 
+# ------------------------------------------------------ profiling plane
+def profiler_samples_counter() -> Counter:
+    """Stack samples taken by the continuous sampling profiler
+    (obs/profiling.py) — one per sampled thread per tick.  A rate()
+    of ~0 while the operator is up means the profiling plane stalled
+    (the UpgradeProfilerStalled alert pages on it).
+
+    Returns the metric OBJECT (the write-pipeline pattern): the
+    sampler tick is the hottest always-on loop in the process and must
+    not re-resolve through the registry's create-or-get lock."""
+    return default_registry().counter(
+        "profiler_samples_total",
+        "Wall-clock stack samples taken by the sampling profiler.",
+    )
+
+
+def profile_overhead_gauge() -> Gauge:
+    """The profiler's own cost as a fraction of one core's wall clock
+    (sampling_seconds / elapsed) — self-measured each tick, gated <= 5%
+    by the bench's profile_overhead_pct_1024n probe and alerted on by
+    UpgradeProfilerOverheadHigh."""
+    return default_registry().gauge(
+        "profile_overhead",
+        "Sampling-profiler self-cost as a fraction of one core "
+        "(sampler seconds per wall second).",
+    )
+
+
 def record_leader_transition(event: str) -> None:
     """Leader-election lifecycle: acquired | lost | released."""
     default_registry().counter(

@@ -3,12 +3,15 @@
 The reference library is logr-only (SURVEY.md §5 — even its one
 aggregate-progress event is commented out); this package holds the
 signals this reproduction grew beyond it: :mod:`.tracing` (in-process
-spans + W3C traceparent propagation + Chrome/OTLP exporters).  Metrics
-live in :mod:`..metrics` (predating this package); the HTTP surface for
-both is :class:`~..controller.ops_server.OpsServer`.
+spans + W3C traceparent propagation + Chrome/OTLP exporters),
+:mod:`.profiling` (the continuous sampling profiler with span
+self-time attribution), and :mod:`.overhead` (the interleaved
+paired-ratio methodology the bench's overhead gates share).  Metrics
+live in :mod:`..metrics` (predating this package); the HTTP surface
+for all of them is :class:`~..controller.ops_server.OpsServer`.
 """
 
-from . import events, slo
+from . import events, overhead, profiling, slo
 from .tracing import (
     Span,
     TraceContextFilter,
@@ -31,6 +34,8 @@ from .tracing import (
 
 __all__ = [
     "events",
+    "overhead",
+    "profiling",
     "slo",
     "Span",
     "TraceContextFilter",

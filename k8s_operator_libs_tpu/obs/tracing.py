@@ -60,6 +60,8 @@ __all__ = [
     "render_trace_tree",
     "selftest",
     "set_default_tracer",
+    "set_span_observer",
+    "span_observer",
     "start_span",
     "to_chrome",
     "to_otlp",
@@ -79,6 +81,34 @@ DEFAULT_CAPACITY = 64
 DEFAULT_MAX_SPANS = 4096
 
 _rand = random.Random()
+
+#: Optional process-wide span lifecycle observer (an object with
+#: ``span_started(span)`` / ``span_ended(span)``) — the hook the
+#: sampling profiler (:mod:`.profiling`) uses to keep a per-thread
+#: stack of ACTIVE spans so wall-clock samples attribute to span
+#: kinds.  One attribute read per span start/end when unset, so the
+#: tracer's always-on cost is unchanged for processes that never
+#: profile.  Module-level (not per-Tracer): samples must attribute no
+#: matter which tracer a component records into, exactly like the
+#: metrics registry's process-default.
+_span_observer = None
+
+
+def span_observer():
+    """The installed span observer, or None."""
+    return _span_observer
+
+
+def set_span_observer(observer):
+    """Install (or with ``None`` remove) the process-wide span
+    observer; returns the previous one.  Observer exceptions are NEVER
+    swallowed here by design — the only installer is the profiler,
+    whose callbacks are two dict operations; a broken observer should
+    fail loudly in tests, not silently skew attribution."""
+    global _span_observer
+    previous = _span_observer
+    _span_observer = observer
+    return previous
 
 
 def _new_trace_id() -> str:
@@ -167,6 +197,9 @@ class Span:
         self.duration = time.monotonic() - self._start_mono
         if self.status == "unset":
             self.status = "ok"
+        observer = _span_observer
+        if observer is not None:
+            observer.span_ended(self)
         self._tracer._record(self)
 
     # ------------------------------------------------------- context manager
@@ -285,6 +318,9 @@ class Tracer:
         # entry their root already created.
         span = Span(self, name, trace_id, _new_span_id(), parent_id, attributes)
         span._token = self._current.set(span)
+        observer = _span_observer
+        if observer is not None:
+            observer.span_started(span)
         return span
 
     def record_span(

@@ -64,6 +64,24 @@ def fresh_tracer():
 
 
 @pytest.fixture(autouse=True)
+def fresh_span_observer():
+    """Per-test profiler-hook isolation: the tracing span observer and
+    the default profiler are process-global (like the tracer); a test
+    that installs a profiler and fails mid-way must not leave its
+    observer attributing every later test's spans."""
+    from k8s_operator_libs_tpu.obs import profiling, tracing
+
+    prev_observer = tracing.span_observer()
+    prev_profiler = profiling.set_default_profiler(
+        profiling.SamplingProfiler()
+    )
+    yield
+    fresh = profiling.set_default_profiler(prev_profiler)
+    fresh.stop()
+    tracing.set_span_observer(prev_observer)
+
+
+@pytest.fixture(autouse=True)
 def fresh_flight_recorder():
     """Per-test flight-recorder isolation: the default recorder is
     process-global (like the tracer); a fresh one per test keeps phase

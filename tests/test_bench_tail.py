@@ -59,6 +59,48 @@ def stubbed_probes(monkeypatch):
             "rollback_trip_s_1024n": 99999.99,
         },
     )
+    monkeypatch.setattr(
+        bench,
+        "bench_profile_overhead",
+        lambda *a, **k: {"profile_overhead_pct_1024n": 99999.99},
+    )
+    frame = "x" * 32  # the trimmed-label ceiling bench emits
+    monkeypatch.setattr(
+        bench,
+        "bench_differential_profiles",
+        lambda *a, **k: {
+            "profile_http_top": {f"{frame[:-1]}{i}": 99.9 for i in range(3)},
+            "profile_engine_off_top": {
+                f"{frame[:-1]}{i}": 99.9 for i in range(3)
+            },
+            "profile_inmem_top": {
+                f"{frame[:-1]}{i}": 99.9 for i in range(3)
+            },
+            "profile_http_regressing": [
+                {
+                    "frame": "y" * 40,
+                    "old_pct": 99.99,
+                    "new_pct": 99.99,
+                    "delta_pct": 99.99,
+                }
+            ]
+            * 5,
+            "profile_engine_off_regressing": [
+                {
+                    "frame": "y" * 40,
+                    "old_pct": 99.99,
+                    "new_pct": 99.99,
+                    "delta_pct": 99.99,
+                }
+            ]
+            * 5,
+            "profile_pair_walls_s": {
+                "inmem": 9999.99,
+                "http": 9999.99,
+                "all_off": 9999.99,
+            },
+        },
+    )
     hw = {
         "platform": "tpu",
         "device_kind": "TPU v4 MegaCore (worst-case-width)",
@@ -86,6 +128,10 @@ TRACKED_DETAIL_KEYS = (
     "http_scale_1024_nodes_per_min",
     "http_pipeline_speedup",
     "http_vs_inmem_1024n",
+    "profile_overhead_pct_1024n",
+    # the differential-profiling acceptance: the transport ratio must
+    # arrive WITH the slow side's attributed frame list, not alone
+    "profile_http_top",
 )
 
 
@@ -116,6 +162,39 @@ class TestCompactTail:
             "they must ride BEFORE prose/auxiliary keys in the detail "
             "dict (shedding pops from the end)"
         )
+
+    def test_full_run_tail_parses_inside_the_driver_window(
+        self, stubbed_probes, capsys
+    ):
+        """The r05 regression, replayed: the driver records only the
+        LAST ~2000 chars of stdout and json-parses the final line of
+        that window.  A compact line longer than the window arrives
+        truncated at its FRONT and fails to parse ("parsed": null) even
+        though it was valid JSON on the wire — so this gate applies the
+        driver's exact read to the FULL run's stdout, not just the
+        line-length budget."""
+        bench.main()
+        out = capsys.readouterr().out
+        window = out[-2000:]
+        tail = [ln for ln in window.splitlines() if ln.strip()][-1]
+        parsed = json.loads(tail)  # the driver's own parse must succeed
+        assert parsed["metric"] == "nodes_upgraded_per_min"
+        assert isinstance(parsed["detail"], dict) and parsed["detail"]
+
+    def test_worst_case_shedding_keeps_the_evidence_sections(
+        self, stubbed_probes, capsys
+    ):
+        """Priority shedding (COMPACT_SHED_FIRST) must absorb the
+        budget pressure BEFORE the end-shedding guard reaches the
+        hardware-evidence sections: even at worst-case field widths the
+        tail keeps the tpu section and the slow side's attributed
+        frames (auxiliary walls are what give way)."""
+        bench.main()
+        out = capsys.readouterr().out
+        tail = [ln for ln in out.splitlines() if ln.strip()][-1]
+        detail = json.loads(tail)["detail"]
+        assert "tpu" in detail, "tpu evidence shed from the compact tail"
+        assert detail["profile_http_top"], "slow-side frames shed"
 
     def test_http_only_tail_parses_and_fits(self, stubbed_probes, capsys):
         bench.http_main()

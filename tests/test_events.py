@@ -649,6 +649,7 @@ class TestOpsServerSurfaces:
             endpoints = json.loads(body)["endpoints"]
             assert endpoints == [
                 "/debug/traces",
+                "/debug/profile",
                 "/debug/remediation",
                 "/debug/slo",
                 "/debug/timeline",

@@ -442,6 +442,9 @@ class TestAlertRulesStayInSync:
             m.http_inflight_writes_gauge().set(0)
             m.write_batch_size_histogram().observe(1)
             m.writes_coalesced_counter().inc(amount=0)
+            # profiling-plane family (obs/profiling.py)
+            m.profiler_samples_counter().inc(amount=0)
+            m.profile_overhead_gauge().set(0)
             exposition = registry.render()
         finally:
             m.set_default_registry(prev)

@@ -775,7 +775,10 @@ class TestOpsServerSurfaces:
         try:
             status, body = self._get(srv.url + "/debug")
             assert status == 200
-            assert json.loads(body)["endpoints"] == ["/debug/traces"]
+            assert json.loads(body)["endpoints"] == [
+                "/debug/traces",
+                "/debug/profile",
+            ]
         finally:
             srv.stop()
         srv = OpsServer(
@@ -790,6 +793,7 @@ class TestOpsServerSurfaces:
                 assert status == 200
                 assert json.loads(body)["endpoints"] == [
                     "/debug/traces",
+                    "/debug/profile",
                     "/debug/remediation",
                     "/debug/slo",
                     "/debug/timeline",
