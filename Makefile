@@ -12,7 +12,8 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 .PHONY: all test test-fast lint bench bench-scale bench-http smoke graft-check cov \
 	cov-report clean help image .build-image kind-e2e kind-e2e-stub \
 	tpu-smoke tpu-probe tpu-watch tpu-stage verify verify-obs \
-	verify-remediation verify-slo verify-events verify-profile
+	verify-remediation verify-slo verify-events verify-profile \
+	verify-pacing
 
 # Enforced coverage floor (VERDICT r4 next #6).  Full-suite line
 # coverage measured by the zero-dependency sys.monitoring tracer
@@ -77,9 +78,19 @@ verify-profile:
 	$(PYTHON) -m pytest tests/test_profiling.py -q
 	$(PYTHON) -m k8s_operator_libs_tpu profile --selftest
 
+# Analysis-gate/pacing gate: the analysis/history/pacing suite plus
+# the in-process closed-loop smoke (healthy soak auto-advances →
+# injected burn-rate breach throttles the wave → sustained breach
+# aborts to the LKG, every transition verified via the decision
+# stream and /debug/explain).
+verify-pacing:
+	$(PYTHON) -m pytest tests/test_analysis.py -q
+	$(PYTHON) -m k8s_operator_libs_tpu pacing --selftest
+
 # The whole verify chain — every subsystem gate in one target (CI runs
 # this; each sub-gate stays runnable alone for the inner loop).
-verify: verify-obs verify-remediation verify-slo verify-events verify-profile
+verify: verify-obs verify-remediation verify-slo verify-events \
+	verify-profile verify-pacing
 
 lint:
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu examples bench.py __graft_entry__.py

@@ -822,6 +822,137 @@ def bench_profile_overhead(
     return {f"profile_overhead_pct_{nodes}n": round(overhead_pct, 2)}
 
 
+def bench_analysis(
+    slices: int = 256, hosts: int = 4, cycles: int = 30
+) -> dict:
+    """Analysis-gate / adaptive-pacing cost at 1,024 nodes:
+
+    * ``gate_eval_overhead_pct_1024n`` — BuildState+ApplyState on a
+      steady fleet with a full ``analysis`` block (two steps with
+      sustain-windowed conditions + AIMD pacing) vs the same policy
+      with only its ``slos`` block, measured with the shared
+      interleaved paired-ratio methodology (obs/overhead.py;
+      acceptance: <= 5%, the always-on-plane gate);
+    * ``pacing_convergence_s_1024n`` — simulated seconds the AIMD
+      controller takes to recover the wave scale from its floor back
+      to 1.0 after the congestion signal clears, at the default knobs
+      (the "always recovers" property as a tracked latency).
+    """
+    from k8s_operator_libs_tpu.api import (
+        AdaptivePacingSpec,
+        AnalysisSpec,
+        AnalysisStepSpec,
+        IntOrString,
+        SloSpec,
+    )
+    from k8s_operator_libs_tpu.obs import events as events_mod
+    from k8s_operator_libs_tpu.obs.overhead import interleaved_overhead_pct
+    from k8s_operator_libs_tpu.upgrade.analysis import PacingController
+
+    nodes = slices * hosts
+    cluster = InMemoryCluster()
+    fleet = Fleet(cluster, revision_hash="rev1")
+    for s in range(slices):
+        for h in range(hosts):
+            fleet.add_node(f"g{s:03d}-h{h}")
+    slo = SloSpec(
+        max_node_phase_seconds=3600,
+        drain_p99_seconds=300,
+        fleet_completion_deadline_seconds=86400,
+    )
+    base_policy = UpgradePolicySpec(auto_upgrade=True, slos=slo)
+    gated_policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        slos=slo,
+        analysis=AnalysisSpec(
+            steps=(
+                AnalysisStepSpec(
+                    name="soak",
+                    max_exposure=IntOrString("25%"),
+                    # never advances/aborts inside the probe: the timed
+                    # cycles pay the full census + condition evaluation
+                    advance_on=("breaches == 0 for 3600s",),
+                    abort_on=(
+                        "burn:fleetCompletionDeadlineSeconds >= 100 "
+                        "for 3600s",
+                    ),
+                ),
+                AnalysisStepSpec(
+                    name="fleet",
+                    abort_on=("stragglers > 512 for 3600s",),
+                ),
+            ),
+            pacing=AdaptivePacingSpec(),
+        ),
+    )
+    manager = ClusterUpgradeStateManager(
+        cluster,
+        cache=InformerCache(cluster, lag_seconds=0.0),
+        cache_sync_timeout_seconds=5.0,
+        cache_sync_poll_seconds=0.005,
+    )
+    side = {"policy": gated_policy}
+    touch = {"i": 0}
+    try:
+        for _ in range(3):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, side["policy"])
+
+        def steady_cycle() -> None:
+            touch["i"] += 1
+            cluster.patch(
+                "Node",
+                "g000-h0",
+                {"metadata": {"annotations": {"bench/touch": str(touch["i"])}}},
+            )
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, side["policy"])
+
+        gate_overhead_pct = interleaved_overhead_pct(
+            steady_cycle,
+            lambda enabled: side.__setitem__(
+                "policy", gated_policy if enabled else base_policy
+            ),
+            pairs=max(8, cycles),
+        )
+    finally:
+        manager.shutdown()
+
+    # ---- AIMD recovery latency (simulated clock, default knobs): the
+    # controller is driven to its floor under a sustained burn, the
+    # signal clears, and the metric is the simulated seconds until the
+    # scale is back at 1.0.  Deterministic — pure AIMD arithmetic.
+    prev_log = events_mod.set_default_log(
+        events_mod.DecisionEventLog()  # the sim's events stay private
+    )
+    try:
+        controller = PacingController()
+        spec = AdaptivePacingSpec()
+        t = 0.0
+        for _ in range(1000):
+            if controller.scale <= spec.min_scale:
+                break
+            controller.update(
+                spec, burn=10.0, stragglers=0, queue_depth=0.0, now=t
+            )
+            t += spec.adjust_interval_seconds
+        recovery_start = t
+        for _ in range(1000):
+            if controller.scale >= 1.0:
+                break
+            controller.update(
+                spec, burn=0.1, stragglers=0, queue_depth=0.0, now=t
+            )
+            t += spec.adjust_interval_seconds
+        convergence_s = t - recovery_start
+    finally:
+        events_mod.set_default_log(prev_log)
+    return {
+        f"gate_eval_overhead_pct_{nodes}n": round(gate_overhead_pct, 2),
+        f"pacing_convergence_s_{nodes}n": round(convergence_s, 2),
+    }
+
+
 def _profiled(run_fn):
     """Run *run_fn* under a private high-rate sampling profiler with
     span attribution installed; returns ``(result, snapshot)`` — the
@@ -955,6 +1086,10 @@ def scale_section(tuned_policy: UpgradePolicySpec) -> dict:
         **bench_build_state_ab(),
         **bench_timeline_slo(tuned_policy),
         **bench_profile_overhead(tuned_policy),
+        # self-contained: builds its own slos/analysis-gated policies
+        # (the probe A/Bs the analysis block itself, not the tuned
+        # policy's knobs)
+        **bench_analysis(),
         "state_index_rollout_speedup_4096n": round(
             scale_4k_fullbuild_s / scale_4k_s, 3
         ),

@@ -194,6 +194,12 @@ def run_real(args) -> int:
             # not progressing" with a machine-readable reason code)
             events_source=manager.events_status,
             explain_source=manager.explain_node,
+            # analysis gates + adaptive pacing (report is null until
+            # the first reconcile under a policy declaring an analysis
+            # block) and the SLO metrics-history ring behind
+            # /debug/slo?history=1
+            analysis_source=manager.analysis_status,
+            slo_history_source=manager.slo_history,
         ).start()
         ops.add_health_check("controller", runnable.running)
         # A hot HA standby is READY (it serves its purpose: being able
@@ -203,7 +209,7 @@ def run_real(args) -> int:
             f"ops endpoints on {ops.url} "
             "(/metrics /healthz /readyz /debug/traces /debug/profile "
             "/debug/remediation /debug/slo /debug/timeline /debug/events "
-            "/debug/explain)"
+            "/debug/explain /debug/analysis)"
         )
     started = False
     try:

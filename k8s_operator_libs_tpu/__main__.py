@@ -40,6 +40,14 @@ Subcommands:
       python -m k8s_operator_libs_tpu events --kubeconfig --json
       python -m k8s_operator_libs_tpu explain --selftest   # make verify-events
 
+* ``pacing`` — the analysis-gate/adaptive-pacing plane
+  (:mod:`.upgrade.analysis`): the active analysis step, its
+  advance/abort condition values, exposure cap and AIMD wave scale,
+  and the closed-loop selftest.
+
+      python -m k8s_operator_libs_tpu pacing --state-file dump.json --policy p
+      python -m k8s_operator_libs_tpu pacing --selftest   # make verify-pacing
+
 * ``profile`` — the continuous profiling plane (:mod:`.obs.profiling`):
   live-capture a window from the operator's ``/debug/profile``
   endpoint, render a saved dump (span self-time table + top frames,
@@ -287,14 +295,42 @@ def cmd_status(args: argparse.Namespace) -> int:
         # gated fleet re-defers every reconcile): only the SET of
         # distinct decisions is part of the key, so a NEW decision
         # prints immediately but a repeat does not.
+        # ... and the analysis section's volatile numbers (generatedAt,
+        # instantaneous condition values, held-for clocks): only the
+        # GATE STATE — active step, abort/pass position, exposure
+        # remaining, pacing scale — keys the watch, so a step advance,
+        # an abort or a throttle prints immediately but a ticking
+        # held-for clock does not.
         slo = payload.get("slo") or {}
+        analysis = payload.get("analysis") or {}
         change_key = json.dumps(
             {
                 **{
                     k: v
                     for k, v in payload.items()
-                    if k not in ("slo", "decisions")
+                    if k not in ("slo", "decisions", "analysis")
                 },
+                "analysisGate": (
+                    {
+                        "activeStep": analysis.get("activeStep"),
+                        "stepIndex": analysis.get("stepIndex"),
+                        "stepStates": [
+                            (s.get("name"), s.get("state"))
+                            for s in analysis.get("steps") or []
+                        ],
+                        "aborted": analysis.get("aborted"),
+                        "passed": analysis.get("passed"),
+                        "suspended": analysis.get("suspended"),
+                        "exposureRemaining": (
+                            analysis.get("exposure") or {}
+                        ).get("remaining"),
+                        "scale": (analysis.get("pacing") or {}).get(
+                            "scale"
+                        ),
+                    }
+                    if analysis
+                    else None
+                ),
                 "sloBreaches": sorted(
                     b.get("slo", "")
                     for b in (slo.get("slos") or {}).get("breaches") or []
@@ -598,6 +634,76 @@ def cmd_slo(args: argparse.Namespace) -> int:
     breaches = (report.get("slos") or {}).get("breaches") or []
     # poll-friendly: nonzero while a declared SLO is in breach
     return 3 if (breaches and args.wait_exit_code) else 0
+
+
+def cmd_pacing(args: argparse.Namespace) -> int:
+    """Analysis gates + adaptive pacing report: the active step with
+    its advance/abort condition values, the exposure cap, and the AIMD
+    wave scale — offline from a dump (instantaneous approximation) or
+    live (the operator serves the stateful report at
+    ``/debug/analysis``).  ``--selftest`` runs the closed-loop smoke
+    (the ``make verify-pacing`` gate): healthy soak auto-advances →
+    injected burn-rate breach throttles → sustained breach aborts to
+    the LKG, verified through the decision stream."""
+    if args.selftest:
+        from .upgrade import analysis as analysis_mod
+
+        try:
+            print(analysis_mod.selftest())
+        except AssertionError as err:
+            print(f"pacing selftest FAILED: {err}", file=sys.stderr)
+            return 1
+        return 0
+    cluster, rc = _open_source(args, "pacing")
+    if cluster is None:
+        return rc
+    util.set_component_name(args.component)
+    from .cluster.errors import ApiError
+    from .obs import slo as slo_mod
+    from .upgrade import analysis as analysis_mod
+    from .upgrade import timeline as timeline_mod
+    from .upgrade.upgrade_state import UpgradeStateError
+
+    policy, prc, pmsg = _load_policy_cr(args, cluster)
+    if pmsg:
+        print(pmsg, file=sys.stderr)
+    if prc:
+        return prc
+    if policy is None:
+        print(
+            "pacing needs --policy naming a TpuUpgradePolicy with an "
+            "analysis block",
+            file=sys.stderr,
+        )
+        return 2
+    if policy.analysis is None:
+        print(
+            f"TpuUpgradePolicy {args.namespace}/{args.policy} declares "
+            "no analysis block",
+            file=sys.stderr,
+        )
+        return 3
+    _push_topology_keys(policy)
+    recorder = timeline_mod.FlightRecorder()
+    manager = ClusterUpgradeStateManager(cluster, flight_recorder=recorder)
+    try:
+        state = manager.build_state(
+            args.namespace, _parse_selector_arg(args.selector)
+        )
+    except (ApiError, OSError, UpgradeStateError) as err:
+        print(f"cannot read cluster state: {err}", file=sys.stderr)
+        return 2
+    finally:
+        manager.shutdown()
+    slo_report = slo_mod.SloEngine(recorder).evaluate(state, policy)
+    report = analysis_mod.analysis_report(state, policy, slo_report)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(analysis_mod.render_report(report))
+    # poll-friendly: nonzero while an abort condition holds
+    pending_abort = bool(report.get("abortPending") or report.get("aborted"))
+    return 3 if (pending_abort and args.wait_exit_code) else 0
 
 
 def _build_explain_inputs(args: argparse.Namespace, cluster):
@@ -1214,6 +1320,38 @@ def main(argv=None) -> int:
         "and exit 0/1 — the make verify-slo gate (no source needed)",
     )
     sl.set_defaults(func=cmd_slo)
+
+    pc = sub.add_parser(
+        "pacing",
+        help="analysis gates + adaptive pacing: the active step's "
+        "advance/abort condition values, the exposure cap, and the "
+        "AIMD wave scale (offline approximation; the live stateful "
+        "report is OpsServer /debug/analysis); --selftest smokes the "
+        "closed loop end-to-end (soak auto-advance -> throttle -> "
+        "abort-to-LKG)",
+    )
+    _add_source_args(pc)
+    _add_query_args(pc)
+    pc.add_argument(
+        "--policy",
+        default="",
+        help="TpuUpgradePolicy name in the source (must declare an "
+        "analysis block)",
+    )
+    pc.add_argument(
+        "--wait-exit-code",
+        action="store_true",
+        help="exit 3 while an abort condition holds (poll-friendly)",
+    )
+    pc.add_argument(
+        "--selftest",
+        action="store_true",
+        help="run the closed-loop smoke — gated fleet auto-advances a "
+        "canary soak, throttles under injected burn, aborts to the "
+        "LKG — and exit 0/1; the make verify-pacing gate (no source "
+        "needed)",
+    )
+    pc.set_defaults(func=cmd_pacing)
 
     ex = sub.add_parser(
         "explain",

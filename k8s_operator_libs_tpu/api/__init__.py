@@ -2,6 +2,10 @@
 
 from .intstr import IntOrString
 from .upgrade_spec import (
+    AdaptivePacingSpec,
+    AnalysisCondition,
+    AnalysisSpec,
+    AnalysisStepSpec,
     MaintenanceWindowSpec,
     DrainSpec,
     PodDeletionSpec,
@@ -12,9 +16,15 @@ from .upgrade_spec import (
     ValidationError,
     ValidationSpec,
     WaitForCompletionSpec,
+    parse_analysis_condition,
 )
 
 __all__ = [
+    "AdaptivePacingSpec",
+    "AnalysisCondition",
+    "AnalysisSpec",
+    "AnalysisStepSpec",
+    "parse_analysis_condition",
     "MaintenanceWindowSpec",
     "IntOrString",
     "DrainSpec",

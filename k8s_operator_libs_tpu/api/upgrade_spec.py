@@ -409,6 +409,377 @@ class RemediationSpec:
         )
 
 
+#: Metrics an analysis condition may reference (the condition grammar's
+#: left-hand side; docs/observability.md "Analysis gates" documents each).
+#: ``burn:`` and ``phase_p*:`` take a suffix (SLO name / phase name).
+_ANALYSIS_METRIC_PREFIXES = ("burn:", "phase_p50:", "phase_p95:", "phase_p99:")
+_ANALYSIS_BARE_METRICS = ("breaches", "stragglers", "eta", "queue")
+
+#: Conditions referencing these metrics need a declared ``slos`` block
+#: (burn rates and breach sets only exist when targets are declared).
+_ANALYSIS_SLO_METRICS = ("burn:", "breaches")
+
+
+@dataclass(frozen=True)
+class AnalysisCondition:
+    """One parsed analysis condition: ``<metric> <op> <value> [for Ns]``.
+
+    The condition *holds* when the metric satisfies the comparison; with
+    ``for_seconds`` it must have held continuously for that long (the
+    analysis engine evaluates this over the metrics-history ring, not an
+    instantaneous sample — one noisy reconcile must not flip a gate)."""
+
+    raw: str
+    metric: str
+    op: str
+    value: float
+    for_seconds: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "raw": self.raw,
+            "metric": self.metric,
+            "op": self.op,
+            "value": self.value,
+            "forSeconds": self.for_seconds,
+        }
+
+
+def parse_analysis_condition(raw: str) -> AnalysisCondition:
+    """Parse one condition string of the grammar
+    ``<metric> <op> <number> [for <N>s]`` — e.g.
+    ``"burn:fleetCompletionDeadlineSeconds < 1.0 for 60s"`` or
+    ``"stragglers == 0"``.  Raises :class:`ValidationError` on any
+    grammar or vocabulary violation (the CR admission gate)."""
+    import re  # local: keeps the module's import surface dataclass-only
+
+    if not isinstance(raw, str) or not raw.strip():
+        raise ValidationError(
+            f"analysis condition must be a non-empty string, got {raw!r}"
+        )
+    match = re.match(
+        r"^\s*(?P<metric>[A-Za-z0-9_.:\-]+)\s*"
+        r"(?P<op><=|>=|==|!=|<|>)\s*"
+        r"(?P<value>-?\d+(?:\.\d+)?)"
+        r"(?:\s+for\s+(?P<dur>\d+(?:\.\d+)?)s)?\s*$",
+        raw,
+    )
+    if match is None:
+        raise ValidationError(
+            f"analysis condition {raw!r} does not match "
+            f"'<metric> <op> <number> [for <N>s]'"
+        )
+    metric = match.group("metric")
+    if metric not in _ANALYSIS_BARE_METRICS and not any(
+        metric.startswith(p) and len(metric) > len(p)
+        for p in _ANALYSIS_METRIC_PREFIXES
+    ):
+        raise ValidationError(
+            f"analysis condition metric {metric!r} is not one of "
+            f"{_ANALYSIS_BARE_METRICS} or prefixed "
+            f"{_ANALYSIS_METRIC_PREFIXES}"
+        )
+    return AnalysisCondition(
+        raw=raw.strip(),
+        metric=metric,
+        op=match.group("op"),
+        value=float(match.group("value")),
+        for_seconds=float(match.group("dur") or 0.0),
+    )
+
+
+@dataclass
+class AnalysisStepSpec:
+    """One progressive-delivery analysis step (Argo-Rollouts analog).
+
+    While the step is ACTIVE, ``maxExposure`` caps how many units
+    (slice domains when ``sliceAware``, nodes otherwise) may be in
+    version exposure; further admissions defer with reason ``gate:slo``.
+    The step ADVANCES when every ``advanceOn`` condition holds
+    (sustained per its ``for Ns`` clause); the rollout ABORTS when any
+    ``abortOn`` condition holds sustained — the remediation breaker
+    trips (and, with ``remediation.autoRollback``, the fleet reverts to
+    the last-known-good revision).  The LAST step's ``abortOn`` stays
+    armed after it advances, so a whole-rollout burn abort works
+    mid-fleet.  A step with no ``advanceOn`` conditions never advances
+    by itself (a terminal observation stage)."""
+
+    name: str = ""
+    #: Exposure ceiling while this step holds; None = uncapped.
+    max_exposure: Optional[IntOrString] = None
+    #: Condition strings; ALL must hold (sustained) to advance.
+    advance_on: tuple = ()
+    #: Condition strings; ANY holding (sustained) aborts the rollout.
+    abort_on: tuple = ()
+
+    def __post_init__(self) -> None:
+        if isinstance(self.max_exposure, (int, str)):
+            self.max_exposure = IntOrString(self.max_exposure)
+        for field_name in ("advance_on", "abort_on"):
+            value = getattr(self, field_name)
+            if isinstance(value, str):
+                raise ValidationError(
+                    f"analysis step {field_name} must be a list of "
+                    f"condition strings, got the string {value!r}"
+                )
+        self.advance_on = tuple(self.advance_on or ())
+        self.abort_on = tuple(self.abort_on or ())
+
+    def _parsed(self, attr: str) -> tuple:
+        # Parsed-condition memo keyed by the raw tuple (conditions are
+        # strings; tests/live CR edits may swap the tuple): the analysis
+        # engine calls these several times per reconcile, and re-running
+        # the grammar regex per call sat inside the
+        # gate_eval_overhead_pct_1024n budget for no reason.
+        raw = getattr(self, attr)
+        cache = getattr(self, "_parse_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_parse_cache", cache)
+        hit = cache.get(attr)
+        if hit is None or hit[0] != raw:
+            hit = (raw, tuple(parse_analysis_condition(c) for c in raw))
+            cache[attr] = hit
+        return hit[1]
+
+    def parsed_advance(self) -> tuple:
+        return self._parsed("advance_on")
+
+    def parsed_abort(self) -> tuple:
+        return self._parsed("abort_on")
+
+    def validate(self) -> None:
+        if not isinstance(self.name, str) or not self.name:
+            raise ValidationError("analysis step name must be non-empty")
+        self.parsed_advance()
+        self.parsed_abort()
+        if (
+            self.max_exposure is not None
+            and not self.max_exposure.is_percent
+        ):
+            _require_non_negative(
+                "analysis.steps[].maxExposure", self.max_exposure.value  # type: ignore[arg-type]
+            )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"name": self.name}
+        if self.max_exposure is not None:
+            out["maxExposure"] = self.max_exposure.to_raw()
+        if self.advance_on:
+            out["advanceOn"] = list(self.advance_on)
+        if self.abort_on:
+            out["abortOn"] = list(self.abort_on)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AnalysisStepSpec":
+        raw_exposure = d.get("maxExposure")
+        return cls(
+            name=d.get("name", ""),
+            max_exposure=(
+                IntOrString.parse(raw_exposure)
+                if raw_exposure is not None
+                else None
+            ),
+            advance_on=tuple(d.get("advanceOn") or ()),
+            abort_on=tuple(d.get("abortOn") or ()),
+        )
+
+
+@dataclass
+class AdaptivePacingSpec:
+    """AIMD admission pacing from observed SLO pressure.
+
+    Each adjustment interval the controller reads three congestion
+    signals — the worst declared-SLO burn rate, the straggler count,
+    and the async write queue depth — and moves one wave-scale knob
+    congestion-control-style: any signal over its threshold halves the
+    scale (multiplicative decrease, factor ``decrease``); all clear
+    raises it by ``increase`` (additive) back toward 1.0.  The scale
+    multiplies the scheduler's slot budget (never above the policy's
+    declared ``maxUnavailable`` ceiling — scale is capped at 1.0) and
+    the write dispatcher's worker concurrency."""
+
+    #: Burn rate above which the fleet throttles (1.0 = on budget).
+    burn_high: float = 1.0
+    #: Straggler count above which the fleet throttles.
+    max_stragglers: int = 2
+    #: write_queue_depth above which the fleet throttles.
+    queue_high: int = 256
+    #: Additive increase per healthy interval.
+    increase: float = 0.25
+    #: Multiplicative decrease factor per congested interval.
+    decrease: float = 0.5
+    #: Scale floor — the rollout always retains a trickle.
+    min_scale: float = 0.1
+    #: Seconds between adjustments (reconcile-rate independent).
+    adjust_interval_seconds: float = 30.0
+
+    def validate(self) -> None:
+        if self.burn_high <= 0:
+            raise ValidationError(
+                f"analysis.pacing.burnHigh must be > 0, got {self.burn_high!r}"
+            )
+        _require_non_negative(
+            "analysis.pacing.maxStragglers", self.max_stragglers
+        )
+        _require_non_negative("analysis.pacing.queueHigh", self.queue_high)
+        if not (0.0 < float(self.increase) <= 1.0):
+            raise ValidationError(
+                f"analysis.pacing.increase must be in (0, 1], got "
+                f"{self.increase!r}"
+            )
+        if not (0.0 < float(self.decrease) < 1.0):
+            raise ValidationError(
+                f"analysis.pacing.decrease must be in (0, 1), got "
+                f"{self.decrease!r}"
+            )
+        if not (0.0 < float(self.min_scale) <= 1.0):
+            raise ValidationError(
+                f"analysis.pacing.minScale must be in (0, 1], got "
+                f"{self.min_scale!r}"
+            )
+        _require_non_negative(
+            "analysis.pacing.adjustIntervalSeconds",
+            self.adjust_interval_seconds,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.burn_high != 1.0:
+            out["burnHigh"] = self.burn_high
+        if self.max_stragglers != 2:
+            out["maxStragglers"] = self.max_stragglers
+        if self.queue_high != 256:
+            out["queueHigh"] = self.queue_high
+        if self.increase != 0.25:
+            out["increase"] = self.increase
+        if self.decrease != 0.5:
+            out["decrease"] = self.decrease
+        if self.min_scale != 0.1:
+            out["minScale"] = self.min_scale
+        if self.adjust_interval_seconds != 30.0:
+            out["adjustIntervalSeconds"] = self.adjust_interval_seconds
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AdaptivePacingSpec":
+        return cls(
+            burn_high=d.get("burnHigh", 1.0),
+            max_stragglers=d.get("maxStragglers", 2),
+            queue_high=d.get("queueHigh", 256),
+            increase=d.get("increase", 0.25),
+            decrease=d.get("decrease", 0.5),
+            min_scale=d.get("minScale", 0.1),
+            adjust_interval_seconds=d.get("adjustIntervalSeconds", 30.0),
+        )
+
+
+@dataclass
+class AnalysisSpec:
+    """SLO-driven analysis gates + adaptive pacing (extension; grounded
+    in Argo Rollouts' analysis steps).  Closes the observe→decide loop:
+    the SLO engine's report stops being report-only and *drives* the
+    rollout — steps gate exposure on declared conditions, sustained
+    breaches abort to the last-known-good revision, and the AIMD pacing
+    controller modulates wave size and write concurrency from observed
+    pressure.  Every gate decision flows through the decision-event
+    vocabulary (``gate:slo``, ``pacing:adapt``)."""
+
+    #: Ordered steps; empty = no exposure gating (pacing may still run).
+    steps: tuple = ()
+    #: Adaptive pacing; None = static pacing (the scheduler's declared
+    #: budgets alone).
+    pacing: Optional[AdaptivePacingSpec] = None
+
+    def __post_init__(self) -> None:
+        if isinstance(self.steps, (str, dict)):
+            raise ValidationError(
+                f"analysis.steps must be a list of steps, got {self.steps!r}"
+            )
+        self.steps = tuple(
+            s if isinstance(s, AnalysisStepSpec) else AnalysisStepSpec.from_dict(s)
+            for s in (self.steps or ())
+        )
+        if isinstance(self.pacing, dict):
+            # loose-dict input is accepted for steps; pacing must get
+            # the same conversion or validate() would AttributeError on
+            # a plain dict instead of raising ValidationError
+            self.pacing = AdaptivePacingSpec.from_dict(self.pacing)
+
+    def burn_metric_names(self) -> set:
+        """The ``burn:<name>`` suffixes the conditions reference
+        (unparsable conditions skipped — step validation rejects them
+        anyway)."""
+        out = set()
+        for step in self.steps:
+            for raw in tuple(step.advance_on) + tuple(step.abort_on):
+                try:
+                    metric = parse_analysis_condition(raw).metric
+                except ValidationError:
+                    continue
+                if metric.startswith("burn:"):
+                    out.add(metric[len("burn:"):])
+        return out
+
+    def references_slo_metrics(self) -> bool:
+        """True when any condition needs a declared ``slos`` block.
+        Conditions are grammar-parsed (the one parser — no second
+        string-splitting to drift); an unparsable condition counts as
+        not-SLO here, because the step's own validate() rejects it
+        anyway."""
+        for step in self.steps:
+            for raw in tuple(step.advance_on) + tuple(step.abort_on):
+                try:
+                    metric = parse_analysis_condition(raw).metric
+                except ValidationError:
+                    continue
+                if any(
+                    metric == p or metric.startswith(p)
+                    for p in _ANALYSIS_SLO_METRICS
+                ):
+                    return True
+        return False
+
+    def validate(self) -> None:
+        names = set()
+        for step in self.steps:
+            step.validate()
+            if step.name in names:
+                raise ValidationError(
+                    f"analysis step name {step.name!r} is not unique"
+                )
+            names.add(step.name)
+        if self.pacing is not None:
+            self.pacing.validate()
+        if not self.steps and self.pacing is None:
+            raise ValidationError(
+                "analysis block declares neither steps nor pacing — "
+                "remove the block or declare one"
+            )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.steps:
+            out["steps"] = [s.to_dict() for s in self.steps]
+        if self.pacing is not None:
+            out["pacing"] = self.pacing.to_dict()
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AnalysisSpec":
+        return cls(
+            steps=tuple(
+                AnalysisStepSpec.from_dict(s) for s in d.get("steps") or ()
+            ),
+            pacing=(
+                AdaptivePacingSpec.from_dict(d["pacing"])
+                if d.get("pacing") is not None
+                else None
+            ),
+        )
+
+
 @dataclass
 class SloSpec:
     """Rollout service-level objectives, evaluated each reconcile by the
@@ -465,6 +836,18 @@ class SloSpec:
             )
         if self.straggler_factor != 3.0:
             out["stragglerFactor"] = self.straggler_factor
+        return out
+
+    def declared_burn_names(self) -> set:
+        """The SLO names the engine will publish burn rates for — the
+        vocabulary ``burn:<name>`` analysis conditions may reference."""
+        out = set()
+        if self.max_node_phase_seconds > 0:
+            out.add("maxNodePhaseSeconds")
+        if self.drain_p99_seconds > 0:
+            out.add("drainP99Seconds")
+        if self.fleet_completion_deadline_seconds > 0:
+            out.add("fleetCompletionDeadlineSeconds")
         return out
 
     @classmethod
@@ -547,6 +930,13 @@ class UpgradePolicySpec:
     #: disables SLO evaluation (analytics stay available on demand via
     #: the ``slo`` CLI / ``/debug/slo``).
     slos: Optional[SloSpec] = None
+    #: SLO-driven analysis gates + adaptive pacing (see
+    #: :class:`AnalysisSpec`): declared steps gate version exposure on
+    #: ``advanceOn``/``abortOn`` conditions over the ``slos`` block's
+    #: burn rates, a sustained abort trips the remediation breaker /
+    #: LKG rollback, and the AIMD pacing controller modulates wave size
+    #: and write concurrency.  None = the SLO plane stays report-only.
+    analysis: Optional[AnalysisSpec] = None
 
     def __post_init__(self) -> None:
         if isinstance(self.max_unavailable, (int, str)):
@@ -596,9 +986,32 @@ class UpgradePolicySpec:
             self.validation,
             self.remediation,
             self.slos,
+            self.analysis,
         ):
             if sub is not None:
                 sub.validate()
+        if (
+            self.analysis is not None
+            and self.slos is None
+            and self.analysis.references_slo_metrics()
+        ):
+            raise ValidationError(
+                "analysis conditions reference burn rates / breaches but "
+                "the policy declares no slos block — the metrics they "
+                "gate on would never exist"
+            )
+        if self.analysis is not None and self.slos is not None:
+            declared = self.slos.declared_burn_names()
+            for name in sorted(self.analysis.burn_metric_names()):
+                if name not in declared:
+                    # a typo'd SLO name would otherwise pass admission
+                    # and silently never hold — wedging the rollout at
+                    # the step's exposure cap forever
+                    raise ValidationError(
+                        f"analysis condition references burn:{name} but "
+                        f"the slos block declares no such target "
+                        f"(declared: {sorted(declared) or 'none'})"
+                    )
         if self.max_unavailable is not None and not self.max_unavailable.is_percent:
             _require_non_negative("maxUnavailable", self.max_unavailable.value)  # type: ignore[arg-type]
 
@@ -642,6 +1055,8 @@ class UpgradePolicySpec:
             out["remediation"] = self.remediation.to_dict()
         if self.slos is not None:
             out["slos"] = self.slos.to_dict()
+        if self.analysis is not None:
+            out["analysis"] = self.analysis.to_dict()
         return out
 
     @classmethod
@@ -697,6 +1112,11 @@ class UpgradePolicySpec:
             slos=(
                 SloSpec.from_dict(d["slos"])
                 if d.get("slos") is not None
+                else None
+            ),
+            analysis=(
+                AnalysisSpec.from_dict(d["analysis"])
+                if d.get("analysis") is not None
                 else None
             ),
         )

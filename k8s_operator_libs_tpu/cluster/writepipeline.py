@@ -307,6 +307,12 @@ class WriteDispatcher:
             getattr(cluster, "batch_write", None) if use_batch else None
         )
         self._max_workers = max(1, max_workers)
+        #: Soft concurrency cap (adaptive pacing): claims park while
+        #: this many batches are in flight.  ``set_worker_scale`` moves
+        #: it inside [1, max_workers]; max_workers stays the hard pool
+        #: bound (threads are held, never killed, so throttling is a
+        #: claim gate, not a pool resize).
+        self._target_claims = self._max_workers
         self._max_batch = max(1, max_batch)
         self._mutex = mutex
         self._mutex_key = mutex_key or (
@@ -320,6 +326,11 @@ class WriteDispatcher:
         self._key_queues: Dict[Tuple[str, str, str], deque] = {}
         self._inflight_keys: set = set()
         self._inflight = 0  # claimed entries not yet completed
+        #: claimed BATCHES not yet completed — the adaptive throttle's
+        #: unit (comparing entry counts against the worker-unit target
+        #: would serialize batching mode: one 64-write batch already
+        #: exceeds any worker count)
+        self._inflight_batches = 0
         self._flushing = 0  # >0 disables the coalesce-window hold
         self._closed = False
         self._threads: List[threading.Thread] = []
@@ -438,8 +449,35 @@ class WriteDispatcher:
         with self._cond:
             return len(self._order)
 
+    def set_worker_scale(self, scale: float) -> None:
+        """Adaptive pacing hook: scale the concurrent-claim cap to
+        ``max(1, round(max_workers * scale))``.  Scale is clamped to
+        (0, 1] semantics — the configured ``max_workers`` remains the
+        hard ceiling; 1 write stream always survives so the pipeline
+        can never be throttled to a standstill."""
+        with self._cond:
+            target = max(
+                1,
+                min(
+                    self._max_workers,
+                    int(round(self._max_workers * float(scale))),
+                ),
+            )
+            if target != self._target_claims:
+                self._target_claims = target
+                self._cond.notify_all()
+
+    @property
+    def worker_target(self) -> int:
+        with self._cond:
+            return self._target_claims
+
     # ------------------------------------------------------------- workers
     def _claim_locked(self) -> List[_Entry]:
+        if self._inflight_batches >= self._target_claims:
+            # adaptive throttle: enough batches on the wire already —
+            # park until a completion frees a claim slot
+            return []
         batch: List[_Entry] = []
         keys: set = set()
         now = time.monotonic()
@@ -470,6 +508,7 @@ class WriteDispatcher:
                 self._inflight_keys.add(key)
             self._order = deque(e for e in self._order if not e.claimed)
             self._inflight += len(batch)
+            self._inflight_batches += 1
         return batch
 
     def _run(self) -> None:
@@ -508,6 +547,7 @@ class WriteDispatcher:
                     for entry in batch:
                         self._inflight_keys.discard(entry.op.key())
                     self._inflight -= len(batch)
+                    self._inflight_batches -= 1
                     self._cond.notify_all()
 
     def _locks_for(self, batch: List[_Entry]) -> List[str]:

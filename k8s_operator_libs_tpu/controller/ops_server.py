@@ -31,7 +31,14 @@ server exposing
   was wired (usually ``manager.remediation_status``); 404 otherwise;
 * ``GET /debug/slo`` — the SLO engine's latest report (ETA, stragglers,
   breaches, burn rates) when an *slo_source* was wired (usually
-  ``manager.slo_status``); 404 otherwise;
+  ``manager.slo_status``); 404 otherwise; ``?history=1`` inlines the
+  metrics-history ring's windowed samples (the observations the
+  analysis engine's sustained conditions evaluate over) when an
+  *slo_history_source* was wired;
+* ``GET /debug/analysis`` — the analysis engine's latest report (step
+  states, condition values with held-for windows, exposure cap, AIMD
+  pacing scale) when an *analysis_source* was wired (usually
+  ``manager.analysis_status``); 404 otherwise;
 * ``GET /debug/timeline`` — the flight recorder's per-node phase
   timelines when a *timeline_source* was wired (usually
   ``manager.timeline_status``); ``?node=<name>`` filters to one node
@@ -109,6 +116,8 @@ class OpsServer:
         timeline_source: Optional[Callable[..., dict]] = None,
         events_source: Optional[Callable[[], Optional[dict]]] = None,
         explain_source: Optional[Callable[[str], Optional[dict]]] = None,
+        analysis_source: Optional[Callable[[], Optional[dict]]] = None,
+        slo_history_source: Optional[Callable[[], Optional[dict]]] = None,
     ) -> None:
         # All-interfaces default, like controller-runtime's metrics/probe
         # listeners: kubelet probes and Prometheus scrapes arrive on the
@@ -160,6 +169,13 @@ class OpsServer:
         #: Callable answering explain_node(name); absent means
         #: /debug/explain 404s.
         self._explain_source = explain_source
+        #: Callable returning the analysis engine's latest report
+        #: (steps, conditions, exposure, pacing); absent means
+        #: /debug/analysis 404s.
+        self._analysis_source = analysis_source
+        #: Callable returning the SLO metrics-history ring's snapshot;
+        #: served inline by /debug/slo?history=1 when wired.
+        self._slo_history_source = slo_history_source
         # THE debug route registry: path -> handler(query).  The /debug
         # index is DERIVED from this dict, so a wired endpoint can never
         # be missing from it (the index used to be maintained by hand —
@@ -182,6 +198,8 @@ class OpsServer:
             self._debug_routes["/debug/events"] = self._render_events
         if explain_source is not None:
             self._debug_routes["/debug/explain"] = self._render_explain
+        if analysis_source is not None:
+            self._debug_routes["/debug/analysis"] = self._render_analysis
         self._health_checks: Dict[str, Check] = {}
         self._ready_checks: Dict[str, Check] = {}
         self._lock = threading.Lock()
@@ -318,8 +336,27 @@ class OpsServer:
             (json.dumps(payload) + "\n").encode(),
         )
 
-    def _render_slo(self, _query: Dict[str, list]) -> Tuple[int, str, bytes]:
+    def _render_slo(self, query: Dict[str, list]) -> Tuple[int, str, bytes]:
         payload = {"configured": True, "report": self._slo_source()}
+        if (query.get("history") or [""])[0] in ("1", "true"):
+            # windowed samples of the SLO gauges (obs/history.py) — the
+            # observations the analysis engine's sustained conditions
+            # evaluate over; null when no history source is wired
+            payload["history"] = (
+                self._slo_history_source()
+                if self._slo_history_source is not None
+                else None
+            )
+        return (
+            200,
+            "application/json",
+            (json.dumps(payload) + "\n").encode(),
+        )
+
+    def _render_analysis(
+        self, _query: Dict[str, list]
+    ) -> Tuple[int, str, bytes]:
+        payload = {"configured": True, "report": self._analysis_source()}
         return (
             200,
             "application/json",

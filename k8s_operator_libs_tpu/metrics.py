@@ -704,6 +704,66 @@ def retire_slo_gauges() -> None:
         gauge.clear()
 
 
+# ------------------------------------------------- analysis gates / pacing
+#: analysis_gate_state encoding (documented in docs/observability.md).
+ANALYSIS_STEP_PENDING = 0.0
+ANALYSIS_STEP_ACTIVE = 1.0
+ANALYSIS_STEP_PASSED = 2.0
+ANALYSIS_STEP_ABORTED = 3.0
+
+
+def _analysis_gauge_families() -> tuple:
+    """The analysis-plane gauge families, shared by publish and retire
+    (the SLO-gauge pattern): (gate_state, wave_scale)."""
+    reg = default_registry()
+    return (
+        reg.gauge(
+            "analysis_gate_state",
+            "Per-analysis-step gate state (0 pending, 1 active, "
+            "2 passed, 3 aborted).",
+            ("step",),
+        ),
+        reg.gauge(
+            "pacing_wave_scale",
+            "Adaptive (AIMD) wave-scale multiplier applied to the "
+            "scheduler's slot budget and the write dispatcher's "
+            "concurrency (1.0 = unthrottled).",
+        ),
+    )
+
+
+def publish_analysis_gauges(
+    step_states: Dict[str, float], wave_scale: float
+) -> None:
+    """Analysis-engine state, re-published each reconcile: every
+    declared step's gate position and the current pacing scale.
+    Atomic family replace, like the SLO gauges — a step removed from
+    the block disappears instead of freezing."""
+    state_g, scale_g = _analysis_gauge_families()
+    state_g.replace({(step,): value for step, value in step_states.items()})
+    scale_g.set(wave_scale)
+
+
+def retire_analysis_gauges() -> None:
+    """The policy lost its ``analysis`` block: REMOVE the analysis
+    series from the exposition (removal, not zeroing — the SLO-gauge
+    retirement contract; a retired gate stuck at 'aborted' would page
+    UpgradeRolloutAbortedOnSlo forever on a fleet whose analysis was
+    intentionally turned off)."""
+    for gauge in _analysis_gauge_families():
+        gauge.clear()
+
+
+def record_pacing_adjustment(direction: str) -> None:
+    """The AIMD pacing controller moved the wave scale
+    (direction = increase | decrease)."""
+    default_registry().counter(
+        "pacing_adjustments_total",
+        "Adaptive pacing wave-scale adjustments, by direction.",
+        ("direction",),
+    ).inc(direction or "unknown")
+
+
 # ------------------------------------------------------ write pipeline
 #: Batch-size buckets: powers of two up to the dispatcher's max_batch
 #: scale — latency buckets would be meaningless for a count metric.

@@ -80,6 +80,9 @@ EVENT_QUARANTINE_RELEASED = "QuarantineReleased"
 EVENT_BREAKER_TRIPPED = "BreakerTripped"
 EVENT_ROLLBACK_STARTED = "RollbackStarted"
 EVENT_SLO_BREACHED = "SloBreached"
+EVENT_ANALYSIS_STEP_ADVANCED = "AnalysisStepAdvanced"
+EVENT_ANALYSIS_ABORTED = "AnalysisAborted"
+EVENT_PACING_ADAPTED = "PacingAdapted"
 
 #: Reason codes (machine-readable; the full table lives in
 #: docs/observability.md and must stay in sync with it).
@@ -94,6 +97,8 @@ REASON_REMEDIATION = "gate:remediation"  # NodeDeferred: breaker open
 REASON_SKIP = "skip"                    # NodeDeferred: skip label
 REASON_SLICE_DOMAIN = "slice-domain"    # NodeDeferred: domain can never fit pacing
 REASON_ROLLBACK_OVERTOOK = "rollback-overtook"  # NodeUnadmitted
+REASON_SLO_GATE = "gate:slo"            # NodeDeferred/Analysis*: analysis gate
+REASON_PACING_ADAPT = "pacing:adapt"    # PacingAdapted: AIMD scale change
 
 #: Fleet-level events (no single node) carry this target.
 FLEET_TARGET = "fleet"
@@ -106,6 +111,7 @@ GATE_REASONS: Dict[str, Tuple[str, ...]] = {
     "maintenanceWindow": (REASON_WINDOW,),
     "pacing": (REASON_PACING, REASON_SLICE_DOMAIN),
     "remediation": (REASON_REMEDIATION, REASON_QUARANTINE),
+    "analysis": (REASON_SLO_GATE,),
 }
 
 #: Default bound on retained (deduplicated) decision entries.
@@ -516,6 +522,7 @@ class ClusterDecisionEventSink:
                     EVENT_NODE_DRAIN_FAILED,
                     EVENT_NODE_UPGRADE_FAILED,
                     EVENT_SLO_BREACHED,
+                    EVENT_ANALYSIS_ABORTED,
                 )
                 else "Normal"
             ),
@@ -780,6 +787,9 @@ _KNOWN_TYPES = frozenset(
         EVENT_BREAKER_TRIPPED,
         EVENT_ROLLBACK_STARTED,
         EVENT_SLO_BREACHED,
+        EVENT_ANALYSIS_STEP_ADVANCED,
+        EVENT_ANALYSIS_ABORTED,
+        EVENT_PACING_ADAPTED,
     )
 )
 
@@ -885,6 +895,7 @@ def explain_node(
     slo_report: Optional[dict] = None,
     decisions: Optional[List[dict]] = None,
     now: Optional[float] = None,
+    analysis: Optional[dict] = None,
 ) -> Optional[dict]:
     """"Why is node X not progressing" as one machine-readable dict, or
     None when the snapshot does not manage the node.
@@ -945,8 +956,22 @@ def explain_node(
     ]
     out["recentEvents"] = node_decisions[-10:]
 
-    # ---- gates (policy-defined; empty without one)
-    gates = _evaluate_gates(state, policy) if policy is not None else []
+    # ---- gates (policy-defined; empty without one).  The analysis
+    # gate rides the caller's live report when given, else the pure
+    # offline approximation over the same slo_report this explain uses.
+    if (
+        analysis is None
+        and policy is not None
+        and getattr(policy, "analysis", None) is not None
+    ):
+        from ..upgrade.analysis import analysis_report
+
+        analysis = analysis_report(state, policy, slo_report, now=now)
+    gates = (
+        _evaluate_gates(state, policy, analysis=analysis)
+        if policy is not None
+        else []
+    )
     blocking = [g for g in gates if g.blocking]
     out["blockingGate"] = blocking[0].to_dict() if blocking else None
 

@@ -45,6 +45,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .. import metrics
+from . import history as history_mod
 
 #: Default straggler multiplier: a node in a phase > k× that phase's p95.
 DEFAULT_STRAGGLER_FACTOR = 3.0
@@ -414,6 +415,16 @@ class SloEngine:
         self._rollout_active = False
         self._breached: set = set()
         self._last_report: Optional[dict] = None
+        #: Whether the previous evaluation published the SLO gauge
+        #: families — an ``slos`` block removed MID-ROLLOUT (analytics
+        #: may keep evaluating for an ``analysis`` block) must retire
+        #: them exactly like a removed remediation block retires its
+        #: gauges, not leave them frozen at the last breach.
+        self._published_gauges = False
+        #: Windowed samples of the SLO gauges (obs/history.py): the
+        #: analysis engine's sustained-condition oracle and the
+        #: ``/debug/slo?history=1`` surface.
+        self.history = history_mod.MetricsHistory()
 
     # ------------------------------------------------------------- plumbing
     def _timelines(self) -> List[dict]:
@@ -460,10 +471,12 @@ class SloEngine:
         remaining = int(counts.get("pending", 0)) + int(
             counts.get("inProgress", 0)
         )
+        fresh_rollout = False
         with self._lock:
             if remaining > 0 and not self._rollout_active:
                 # a NEW rollout: re-stamp, scoping out prior history
                 self._rollout_active = True
+                fresh_rollout = True
                 self._rollout_started = (
                     rollout_started_estimate(timelines) or now
                 )
@@ -472,6 +485,14 @@ class SloEngine:
                 # wave that just finished until a new one begins
                 self._rollout_active = False
             started = self._rollout_started
+        if fresh_rollout:
+            # The metrics-history ring restarts with the rollout: a
+            # sustained-condition streak ("breaches == 0 for 300s")
+            # must soak the NEW revision's observations — an hour of
+            # pre-rollout idle-healthy samples would satisfy it
+            # vacuously on the first reconcile (and a prior rollout's
+            # sustained burn could insta-abort a fixed one).
+            self.history.clear()
         analytics = analyze(
             timelines, counts, now=now, straggler_factor=factor,
             since=started,
@@ -479,6 +500,33 @@ class SloEngine:
         report = dict(analytics)
         report["generatedAt"] = now
         report["rolloutStartedAt"] = started
+        # History samples for the analysis engine's sustained-condition
+        # windows (+ /debug/slo?history=1): analytics series always,
+        # burn/breach series only under a declared slos block.
+        samples: Dict[str, float] = {
+            "rollout_stragglers": float(len(analytics["stragglers"])),
+        }
+        eta_seconds = (analytics.get("eta") or {}).get("seconds")
+        if eta_seconds is not None:
+            # an UNKNOWN eta records nothing (not the -1 gauge
+            # sentinel): "eta <= N" must be unobserved — never
+            # vacuously held — while the engine cannot project yet
+            samples["rollout_eta_seconds"] = float(eta_seconds)
+        for phase, stat in analytics["phases"].items():
+            for q, _ in _QUANTILES:
+                samples[f"slo_phase_seconds:{phase}:{q}"] = stat[q]
+        if slos is None:
+            # The slos block is gone but the engine keeps evaluating
+            # (an analysis block still wants the analytics): retire the
+            # gauge families and the breach edge-detector so dashboards
+            # and the breach set don't outlive the block (same
+            # retirement contract as remediation).
+            with self._lock:
+                self._breached = set()
+            if self._published_gauges:
+                self._published_gauges = False
+                metrics.retire_slo_gauges()
+            self.history.record(samples, now=now)
         if slos is not None:
             breaches, burn = evaluate_slos(
                 analytics, timelines, slos, now, started
@@ -519,6 +567,11 @@ class SloEngine:
                 burn_rates=burn,
                 breached={b["slo"] for b in breaches},
             )
+            self._published_gauges = True
+            for name, rate in burn.items():
+                samples[f"slo_burn_rate:{name}"] = rate
+            samples["slo_breaches"] = float(len(breaches))
+            self.history.record(samples, now=now)
         with self._lock:
             self._last_report = report
         return report
@@ -533,7 +586,9 @@ class SloEngine:
             self._rollout_started = None
             self._rollout_active = False
             self._breached = set()
+        self.history.clear()
         if had:
+            self._published_gauges = False
             metrics.retire_slo_gauges()
 
     def last_report(self) -> Optional[dict]:

@@ -158,6 +158,10 @@ class NodeUpgradeStateProvider:
         #: Lazily created, provider-lifetime write dispatcher for
         #: pipelined_writes (batched against transports that batch).
         self._write_dispatcher = None
+        #: Adaptive pacing scale for the dispatcher's write concurrency
+        #: (set by the manager from the analysis engine's AIMD
+        #: controller; applied to a dispatcher created later too).
+        self._write_scale = 1.0
         #: Async-visibility mode (opted in by the manager alongside the
         #: write pipeline): writes from threads with NO thread-local
         #: defer/pipeline context — the async drain/pod workers — record
@@ -440,6 +444,8 @@ class NodeUpgradeStateProvider:
                 # writes are eager and never pay it
                 coalesce_window_s=0.015 if batching else 0.0,
             )
+            if self._write_scale < 1.0:
+                dispatcher.set_worker_scale(self._write_scale)
             self._write_dispatcher = dispatcher
         pipe = _WritePipeline(dispatcher)
         self._local.pipeline = pipe
@@ -454,6 +460,17 @@ class NodeUpgradeStateProvider:
             # overwrite that pass's fresh write and regress a node's
             # state (KeyedMutex serializes, it does not order)
             pipe.join()
+
+    def set_write_concurrency_scale(self, scale: float) -> None:
+        """Adaptive pacing (upgrade/analysis.py): scale the write
+        dispatcher's concurrent-claim cap by the AIMD wave scale, so
+        admission backpressure reaches the transport too.  Applies to
+        the live dispatcher immediately and to one created later;
+        scale 1.0 restores the configured concurrency."""
+        self._write_scale = float(scale)
+        dispatcher = self._write_dispatcher
+        if dispatcher is not None:
+            dispatcher.set_worker_scale(self._write_scale)
 
     def close(self) -> None:
         """Release the write dispatcher's workers (short-lived embedders;

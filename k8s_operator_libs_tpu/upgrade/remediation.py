@@ -217,22 +217,13 @@ class RemediationManager:
         decision.quarantined_nodes = tuple(sorted(q_nodes))
         decision.quarantined_domains = frozenset(q_domains)
 
-        primary: Optional[JsonObj] = None
-        targets: Dict[str, str] = {}
-        breaker: Optional[dict] = None
-        for ds_name in sorted(daemon_sets):
-            ds = daemon_sets[ds_name]
-            fresh = self._fresh_ds(ds)
-            target = self._target_hash(common, fresh)
-            if not target:
-                continue
-            targets[ds_name] = target
-            if primary is None:
-                primary = fresh
-                breaker = _parse_json_annotation(
-                    _annotations(fresh).get(util.get_breaker_annotation_key())
-                )
-            decision.lkg[ds_name] = self._track_lkg(fresh, target, breaker)
+        fresh_sets, primary, targets, breaker, _ = self._load_ds_records(
+            daemon_sets, common
+        )
+        for ds_name, target in targets.items():
+            decision.lkg[ds_name] = self._track_lkg(
+                fresh_sets[ds_name], target, breaker
+            )
         if primary is not None:
             decision.target = targets.get(name_of(primary), "")
 
@@ -335,6 +326,143 @@ class RemediationManager:
                    "publish a fixed revision or roll back manually)")
             )
 
+        metrics.publish_remediation_gauges(
+            decision.paused, len(decision.quarantined_nodes)
+        )
+        self._last_decision = decision
+        return decision
+
+    def _load_ds_records(
+        self, daemon_sets: Dict[str, JsonObj], common: CommonUpgradeManager
+    ) -> tuple:
+        """``(fresh_sets, primary, targets, breaker, lkg_records)`` off
+        the (overlay-freshened) driver DaemonSets — the shared head of
+        :meth:`evaluate` and :meth:`trip_for_slo`, so target resolution,
+        first-DS-by-name primary selection, and breaker/LKG record
+        parsing can never diverge between the failure-budget and SLO
+        trip paths."""
+        fresh_sets: Dict[str, JsonObj] = {}
+        primary: Optional[JsonObj] = None
+        targets: Dict[str, str] = {}
+        breaker: Optional[dict] = None
+        lkg_records: Dict[str, dict] = {}
+        for ds_name in sorted(daemon_sets):
+            fresh = self._fresh_ds(daemon_sets[ds_name])
+            fresh_sets[ds_name] = fresh
+            target = self._target_hash(common, fresh)
+            if not target:
+                continue
+            targets[ds_name] = target
+            if primary is None:
+                primary = fresh
+                breaker = _parse_json_annotation(
+                    _annotations(fresh).get(util.get_breaker_annotation_key())
+                )
+            record = _parse_json_annotation(
+                _annotations(fresh).get(
+                    util.get_last_known_good_annotation_key()
+                )
+            )
+            if record is not None:
+                lkg_records[ds_name] = record
+        return fresh_sets, primary, targets, breaker, lkg_records
+
+    # ------------------------------------------------------ SLO-driven trip
+    def trip_for_slo(
+        self,
+        state: ClusterUpgradeState,
+        policy,
+        common: CommonUpgradeManager,
+        reason: str,
+        now: Optional[float] = None,
+    ) -> Optional[RemediationDecision]:
+        """Trip the breaker on an ANALYSIS verdict (a sustained SLO
+        breach — see :mod:`.analysis`) instead of the failure census:
+        the rollout is aborting on *slowness*, not breakage.  Persists
+        the same breaker record the failure path writes (reason carries
+        the analysis condition), pauses fresh admissions, and — under
+        ``autoRollback`` — reverts to the last-known-good revision in
+        the same pass, exactly like a failure-budget trip.  No-ops (and
+        returns the standing decision) when the breaker is already open
+        for the current target or the engine is off."""
+        spec = getattr(policy, "remediation", None)
+        if spec is None:
+            return None
+        now_ts = time.time() if now is None else now
+        daemon_sets: Dict[str, JsonObj] = {}
+        for ns in state.managed_node_states():
+            if ns.driver_daemonset is not None:
+                daemon_sets.setdefault(
+                    name_of(ns.driver_daemonset), ns.driver_daemonset
+                )
+        fresh_sets, primary, targets, breaker, lkg_records = (
+            self._load_ds_records(daemon_sets, common)
+        )
+        if primary is None:
+            return self._last_decision
+        trip_target = targets.get(name_of(primary), "")
+        if breaker is not None and (
+            (
+                breaker.get("state") == "open"
+                and breaker.get("target") in targets.values()
+            )
+            # A record (open OR rolled-back) already charged to this
+            # very target: the abort latch is doing its job — re-tripping
+            # every reconcile until the rollback becomes visible would
+            # spam trips into the counter and the audit stream.
+            or breaker.get("target") == trip_target
+        ):
+            return self._last_decision
+        breaker = {
+            "state": "open",
+            "target": trip_target,
+            "trippedAt": now_ts,
+            "failures": 0,
+            "attempted": 0,
+            "reason": reason,
+        }
+        metrics.record_breaker_trip()
+        events_mod.emit(
+            events_mod.EVENT_BREAKER_TRIPPED,
+            "slo",
+            events_mod.FLEET_TARGET,
+            reason,
+        )
+        log_event(
+            self._recorder,
+            util.get_component_name(),
+            "Warning",
+            util.get_event_reason(),
+            "Remediation breaker TRIPPED on SLO analysis: " + reason,
+        )
+        logger.warning("remediation breaker tripped on SLO: %s", reason)
+        paused = True
+        rollback_active = False
+        if spec.auto_rollback:
+            if self._rollback(fresh_sets, targets, lkg_records, breaker):
+                breaker["state"] = "rolled-back"
+                breaker["rolledBackAt"] = now_ts
+                paused = False
+                rollback_active = True
+        self._persist_breaker(primary, breaker)
+        previous = self._last_decision or RemediationDecision()
+        decision = RemediationDecision(
+            paused=paused,
+            reason=(
+                "remediation breaker open: " + reason
+                if paused
+                else previous.reason
+            ),
+            breaker=breaker,
+            lkg=dict(previous.lkg) or dict(lkg_records),
+            target=trip_target,
+            failures=previous.failures,
+            attempted=previous.attempted,
+            ratio=previous.ratio,
+            quarantined_domains=previous.quarantined_domains,
+            quarantined_nodes=previous.quarantined_nodes,
+            rollback_active=rollback_active,
+        )
         metrics.publish_remediation_gauges(
             decision.paused, len(decision.quarantined_nodes)
         )

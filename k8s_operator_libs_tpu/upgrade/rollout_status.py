@@ -170,6 +170,11 @@ class RolloutStatus:
     #: last-decisions line and the blocking gate's deferred-node count.
     #: None = stream not available.
     decisions: Optional[List[dict]] = None
+    #: Analysis-engine report (upgrade/analysis.py) — active step,
+    #: condition values, exposure, pacing scale — attached when the
+    #: policy declares an ``analysis`` block (the live engine's report,
+    #: or the pure offline approximation).  None = no analysis block.
+    analysis: Optional[dict] = None
 
     # ------------------------------------------------------------- derived
     @property
@@ -195,7 +200,8 @@ class RolloutStatus:
     # --------------------------------------------------------- construction
     @classmethod
     def from_cluster_state(
-        cls, state, policy=None, slo_report=None, decisions=None
+        cls, state, policy=None, slo_report=None, decisions=None,
+        analysis=None,
     ) -> "RolloutStatus":
         """Compute from a :class:`~.common_manager.ClusterUpgradeState`
         snapshot (the object ``build_state`` returns).  Pass the active
@@ -234,7 +240,16 @@ class RolloutStatus:
             domains=sorted(domains.values(), key=lambda d: d.domain),
         )
         if policy is not None:
-            status.gates = _evaluate_gates(state, policy)
+            if analysis is None and getattr(policy, "analysis", None) is not None:
+                # offline approximation (instantaneous conditions) —
+                # the live operator passes its engine's report instead
+                from .analysis import analysis_report
+
+                analysis = analysis_report(state, policy, slo_report)
+            status.analysis = dict(analysis) if analysis is not None else None
+            status.gates = _evaluate_gates(
+                state, policy, analysis=status.analysis
+            )
         if slo_report is not None:
             status.slo = dict(slo_report)
         if decisions is not None:
@@ -274,6 +289,8 @@ class RolloutStatus:
             out["gates"] = [g.to_dict() for g in self.gates]
         if self.slo is not None:
             out["slo"] = dict(self.slo)
+        if self.analysis is not None:
+            out["analysis"] = dict(self.analysis)
         if self.decisions is not None:
             out["decisions"] = [dict(d) for d in self.decisions[-20:]]
         return out
@@ -352,6 +369,57 @@ class RolloutStatus:
             )
         return bits
 
+    # ----------------------------------------------------- analysis plane
+    def _analysis_bits(self) -> List[str]:
+        """Short analysis-gate fragments: active step with its
+        condition values, exposure, and the current pacing scale
+        (empty without an analysis block)."""
+        if self.analysis is None:
+            return []
+        bits: List[str] = []
+        report = self.analysis
+        if report.get("aborted"):
+            bits.append(
+                "analysis ABORTED: " + (report.get("abortReason") or "")
+            )
+        elif report.get("suspended"):
+            bits.append("analysis suspended (remediation recovering)")
+        elif report.get("passed"):
+            bits.append("analysis passed")
+        elif report.get("activeStep"):
+            fragment = (
+                f"analysis step {report['activeStep']!r} "
+                f"({int(report.get('stepIndex') or 0) + 1}/"
+                f"{len(report.get('steps') or [])})"
+            )
+            conds = [
+                c
+                for s in report.get("steps") or []
+                if s.get("state") == "active"
+                for c in s.get("advance") or []
+            ]
+            if conds:
+                fragment += " — advance when " + "; ".join(
+                    f"{c['raw']}"
+                    + (
+                        f" [now {c['value']:g}]"
+                        if c.get("value") is not None
+                        else " [unobserved]"
+                    )
+                    for c in conds
+                )
+            bits.append(fragment)
+        exposure = report.get("exposure")
+        if exposure:
+            bits.append(
+                f"exposure {exposure.get('exposed')}/{exposure.get('cap')} "
+                "units"
+            )
+        scale = (report.get("pacing") or {}).get("scale")
+        if scale is not None and scale < 1.0:
+            bits.append(f"pacing throttled to {scale:.2f}x")
+        return bits
+
     def summary(self, lead_gate: bool = True) -> str:
         """One-line progress summary (the kubectl-rollout-status analog).
         A blocked rollout LEADS with the first blocking gate — the thing
@@ -383,6 +451,12 @@ class RolloutStatus:
         bits = self._slo_bits()
         if lead_gate and bits:
             line += " — " + "; ".join(bits)
+        if lead_gate and self.analysis is not None:
+            scale = (self.analysis.get("pacing") or {}).get("scale")
+            if self.analysis.get("aborted"):
+                line += " — analysis ABORTED [gate:slo]"
+            elif scale is not None and scale < 1.0:
+                line += f" — pacing throttled to {scale:.2f}x"
         return line
 
     def render(self) -> str:
@@ -410,6 +484,12 @@ class RolloutStatus:
             for bit in bits:
                 lines.append(f"  {bit}")
             lines.append("")
+        analysis_bits = self._analysis_bits()
+        if analysis_bits:
+            lines.append("analysis / pacing:")
+            for bit in analysis_bits:
+                lines.append(f"  {bit}")
+            lines.append("")
         decision_lines = self._decision_lines()
         if decision_lines:
             lines.append("last decisions:")
@@ -431,10 +511,13 @@ class RolloutStatus:
         return "\n".join(lines)
 
 
-def _evaluate_gates(state, policy) -> List[GateStatus]:
+def _evaluate_gates(state, policy, analysis=None) -> List[GateStatus]:
     """Evaluate the schedule/canary admission gates against the snapshot
     (same code paths the in-place scheduler uses, so status and scheduler
-    can never disagree about whether admissions are gated)."""
+    can never disagree about whether admissions are gated).  *analysis*
+    is an analysis-engine report (live, or the pure offline
+    approximation) feeding the ``analysis`` gate; absent with a policy
+    that declares the block, the offline approximation is computed."""
     from datetime import datetime, timezone
 
     from . import schedule
@@ -545,6 +628,25 @@ def _evaluate_gates(state, policy) -> List[GateStatus]:
 
     if getattr(policy, "remediation", None) is not None:
         gates.append(_remediation_gate(state))
+
+    if getattr(policy, "analysis", None) is not None:
+        from .analysis import analysis_report, gate_from_report
+
+        if analysis is None:
+            analysis = analysis_report(state, policy, None)
+        pending = len(
+            state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED)
+        )
+        verdict = gate_from_report(analysis, pending)
+        if verdict is not None:
+            gates.append(
+                GateStatus(
+                    gate="analysis",
+                    blocking=bool(verdict["blocking"]),
+                    reason=verdict["reason"],
+                    detail=verdict["detail"],
+                )
+            )
 
     if policy.max_nodes_per_hour > 0:
         budget = schedule.pacing_budget(policy, all_nodes)

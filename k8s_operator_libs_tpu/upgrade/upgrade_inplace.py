@@ -52,6 +52,8 @@ _DEFER_MESSAGES = {
     events_mod.REASON_SKIP: "node carries the skip label",
     events_mod.REASON_SLICE_DOMAIN: "domain larger than maxNodesPerHour "
     "(can never be admitted under this pacing policy)",
+    events_mod.REASON_SLO_GATE: "analysis gate holding (SLO-driven "
+    "exposure cap or sustained-breach abort)",
 }
 
 
@@ -266,6 +268,7 @@ class InplaceNodeStateManager:
         state: ClusterUpgradeState,
         policy: UpgradePolicySpec,
         remediation=None,
+        analysis=None,
     ) -> None:
         common = self._common
         slice_aware = policy.slice_aware
@@ -335,6 +338,37 @@ class InplaceNodeStateManager:
                 remediation.quarantined_domains
             )
 
+        # Analysis gate (upgrade/analysis.py): an aborted analysis
+        # blocks all fresh version exposure (reason gate:slo) until the
+        # target moves off the aborted revision; the AIMD wave scale
+        # multiplies the slot budget (never above the declared
+        # maxUnavailable — scale <= 1.0); the active step's exposure
+        # cap charges fresh units like the canary budget does.
+        analysis_blocked = analysis is not None and analysis.aborted
+        exposure = (
+            analysis.exposure_remaining if analysis is not None else None
+        )
+        if analysis is not None and analysis.wave_scale < 1.0:
+            from .analysis import scaled_slots
+
+            scaled = scaled_slots(available, analysis.wave_scale)
+            if scaled != available:
+                logger.info(
+                    "adaptive pacing: wave scaled %d -> %d slots "
+                    "(scale %.2f)",
+                    available,
+                    scaled,
+                    analysis.wave_scale,
+                )
+                available = scaled
+        if analysis_blocked and state.nodes_in(
+            consts.UPGRADE_STATE_UPGRADE_REQUIRED
+        ):
+            logger.info(
+                "analysis aborted; fresh admissions paused (%s)",
+                analysis.abort_reason,
+            )
+
         log = events_mod.default_log()
         node_states = state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED)
         if slice_aware:
@@ -349,6 +383,8 @@ class InplaceNodeStateManager:
                 remediation_blocked=remediation_blocked,
                 window_closed=window_closed,
                 log=log,
+                analysis_blocked=analysis_blocked,
+                exposure=exposure,
             )
         else:
             admitted, deferred = self._schedule_by_node(
@@ -360,6 +396,8 @@ class InplaceNodeStateManager:
                 remediation_blocked=remediation_blocked,
                 window_closed=window_closed,
                 log=log,
+                analysis_blocked=analysis_blocked,
+                exposure=exposure,
             )
         if admitted:
             # One wave-summary decision per admitting pass (repeats
@@ -437,6 +475,8 @@ class InplaceNodeStateManager:
         remediation_blocked: bool = False,
         window_closed: bool = False,
         log=None,
+        analysis_blocked: bool = False,
+        exposure: Optional[int] = None,
     ) -> tuple:
         """Returns ``(admitted, deferred)`` node counts for the wave
         summary; every defer records a reason-coded decision event."""
@@ -444,6 +484,15 @@ class InplaceNodeStateManager:
         common = self._common
         admitted = 0
         deferrals: dict = {}
+        if analysis_blocked:
+            # An aborted analysis blocks ALL fresh version exposure —
+            # same stance as the breaker, but with the SLO reason code
+            # so explain answers "aborted on slowness, not breakage".
+            for node_state in node_states:
+                _defer(
+                    deferrals, node_state.node, events_mod.REASON_SLO_GATE
+                )
+            return 0, _flush_deferrals(log, deferrals)
         if remediation_blocked:
             # Node-granular mode has no domain-straggler notion: every
             # admission is fresh version exposure, so a tripped breaker
@@ -488,6 +537,12 @@ class InplaceNodeStateManager:
             if canary is not None and canary <= 0:
                 _defer(deferrals, node, events_mod.REASON_CANARY)
                 continue
+            # The analysis step's exposure cap is the same contract as
+            # the canary budget (version exposure), with the SLO gate's
+            # reason code.
+            if exposure is not None and exposure <= 0:
+                _defer(deferrals, node, events_mod.REASON_SLO_GATE)
+                continue
             common.provider.change_node_upgrade_state(
                 node, consts.UPGRADE_STATE_CORDON_REQUIRED
             )
@@ -504,6 +559,8 @@ class InplaceNodeStateManager:
                 pacing -= 1
             if canary is not None:
                 canary -= 1
+            if exposure is not None:
+                exposure -= 1
             available -= 1
         return admitted, _flush_deferrals(log, deferrals)
 
@@ -519,6 +576,8 @@ class InplaceNodeStateManager:
         remediation_blocked: bool = False,
         window_closed: bool = False,
         log=None,
+        analysis_blocked: bool = False,
+        exposure: Optional[int] = None,
     ) -> tuple:
         """Slice-aware scheduling: one slot = one domain; all of a chosen
         domain's upgrade-required nodes advance together.  Returns
@@ -580,6 +639,10 @@ class InplaceNodeStateManager:
             if remediation_blocked and fresh:
                 defer_domain(nodes, events_mod.REASON_REMEDIATION)
                 continue
+            # Aborted analysis: same contract, SLO reason code.
+            if analysis_blocked and fresh:
+                defer_domain(nodes, events_mod.REASON_SLO_GATE)
+                continue
             if not bypass:
                 if available <= 0:
                     defer_domain(
@@ -616,6 +679,10 @@ class InplaceNodeStateManager:
             if canary is not None and fresh and canary <= 0:
                 defer_domain(nodes, events_mod.REASON_CANARY)
                 continue
+            # Analysis exposure cap charges fresh UNITS, like canary.
+            if exposure is not None and fresh and exposure <= 0:
+                defer_domain(nodes, events_mod.REASON_SLO_GATE)
+                continue
             for node in nodes:
                 common.provider.change_node_upgrade_state(
                     node, consts.UPGRADE_STATE_CORDON_REQUIRED
@@ -626,6 +693,8 @@ class InplaceNodeStateManager:
                 admitted += 1
             if canary is not None and fresh:
                 canary -= 1
+            if exposure is not None and fresh:
+                exposure -= 1
             if not bypass:
                 available -= 1
                 if pacing is not None:

@@ -36,6 +36,7 @@ from ..cluster.errors import NotFoundError
 from ..cluster.client import ClusterClient
 from ..cluster.inmem import JsonObj
 from ..cluster.selectors import labels_to_selector
+from . import analysis as analysis_mod
 from . import consts, timeline as timeline_mod, util
 from .common_manager import (
     ClusterUpgradeState,
@@ -116,6 +117,13 @@ class ClusterUpgradeStateManager:
         #: SLO engine (obs/slo.py): evaluates the policy's optional
         #: ``slos`` block each reconcile — entirely inert without one.
         self._slo_engine = slo_mod.SloEngine(flight_recorder)
+        #: Analysis engine (upgrade/analysis.py): SLO-driven gates +
+        #: adaptive pacing under the policy's optional ``analysis``
+        #: block; shares the SLO engine's metrics-history ring so both
+        #: planes judge the same samples.  Inert without the block.
+        self._analysis_engine = analysis_mod.AnalysisEngine(
+            history=self._slo_engine.history
+        )
         self._owned_provider = provider is None
         self._provider = provider or NodeUpgradeStateProvider(
             cluster,
@@ -353,6 +361,19 @@ class ClusterUpgradeStateManager:
         /debug/timeline`` payload (*node* filters at the source)."""
         return self.flight_recorder.snapshot(node)
 
+    def slo_history(self) -> dict:
+        """The SLO metrics-history ring's snapshot — served at
+        ``OpsServer GET /debug/slo?history=1`` (the windows the
+        analysis engine's sustained conditions evaluate over)."""
+        return self._slo_engine.history.snapshot()
+
+    def analysis_status(self) -> Optional[dict]:
+        """The analysis engine's latest report (steps, conditions with
+        held-for windows, exposure, pacing scale) — the ``OpsServer GET
+        /debug/analysis`` payload.  None before the first reconcile
+        under a policy declaring an ``analysis`` block."""
+        return self._analysis_engine.last_report()
+
     # -------------------------------------------------- decision-audit plane
     def events_status(self) -> dict:
         """The decision-event log's snapshot — the ``OpsServer GET
@@ -375,6 +396,7 @@ class ClusterUpgradeStateManager:
             recorder=self.flight_recorder,
             slo_report=self.slo_status(),
             decisions=events_mod.default_log().events(),
+            analysis=self.analysis_status(),
         )
 
     # ------------------------------------------------------------ BuildState
@@ -599,16 +621,30 @@ class ClusterUpgradeStateManager:
             # decision so gauges and /debug/remediation don't keep
             # reporting the last breaker position forever.
             self._remediation.disable()
-        if policy is None or policy.slos is None:
+        if policy is None or (
+            policy.slos is None and policy.analysis is None
+        ):
             # Same retirement contract for the SLO engine: a removed
             # ``slos`` block clears the breach/burn/eta gauges and the
             # /debug/slo report.
             self._slo_engine.disable()
         else:
-            # Report-only evaluation — runs even while the rollout is
-            # paused (auto_upgrade off), because a paused-but-unfinished
-            # rollout is exactly when the deadline burn rate matters.
+            # Evaluation runs under EITHER block — analysis conditions
+            # need the analytics (stragglers/ETA/phase quantiles) even
+            # without declared slos targets; evaluate() itself retires
+            # the SLO gauge families + breach set when only the slos
+            # block was removed mid-rollout.  Runs even while the
+            # rollout is paused (auto_upgrade off), because a
+            # paused-but-unfinished rollout is exactly when the
+            # deadline burn rate matters.
             self._slo_engine.evaluate(state, policy)
+        if policy is None or policy.analysis is None:
+            # Removed ``analysis`` block: retire the gate/pacing gauges,
+            # drop the step cursor and abort latch, and restore the
+            # write pipeline's full concurrency — a removed block must
+            # never keep throttling the fleet.
+            self._analysis_engine.disable()
+            self._set_write_concurrency_scale(1.0)
         if policy is not None:
             self._configure_from_policy(policy)
         else:
@@ -621,6 +657,22 @@ class ClusterUpgradeStateManager:
             # a paused rollout must not leave upgrades_in_progress frozen
             # at its last active value (alerts would fire forever).
             self._publish_gauges(common, state)
+            # The analysis plane stays live while paused too (the same
+            # contract as the SLO engine above): the AIMD scale keeps
+            # recovering once pressure clears — a pause must not freeze
+            # pacing_wave_scale at its last throttle (paging
+            # UpgradePacingThrottled forever) or leave the write
+            # dispatcher's claim cap stuck down.  No trip/scheduling
+            # happens here; a sustained abort latches and acts on
+            # resume.
+            if policy is not None and policy.analysis is not None:
+                decision = self._analysis_engine.evaluate(
+                    state,
+                    policy,
+                    self._slo_engine.last_report(),
+                    common=common,
+                )
+                self._set_write_concurrency_scale(decision.wave_scale)
             # No ack_dirty: a paused pass never processed the snapshot's
             # dirty view, so the index keeps it as scan debt and the
             # scoped scans revisit those nodes once the rollout resumes.
@@ -805,6 +857,37 @@ class ClusterUpgradeStateManager:
         if policy.remediation is not None:
             remediation = self._remediation.evaluate(state, policy, common)
 
+        # Analysis engine (SLO-driven gates + adaptive pacing): consumes
+        # the SLO report evaluated above, AFTER remediation so a paused/
+        # rolling-back fleet suspends the exposure gating (the rollback
+        # wave must not be capped by the analysis that triggered it).
+        # A fresh abort trips the breaker with the SLO reason — the
+        # rollout aborts on slowness through the same LKG machinery
+        # hard failures use.
+        analysis: Optional[analysis_mod.AnalysisDecision] = None
+        if policy.analysis is not None:
+            analysis = self._analysis_engine.evaluate(
+                state,
+                policy,
+                self._slo_engine.last_report(),
+                common=common,
+                remediation=remediation,
+            )
+            if (
+                analysis.aborted
+                and policy.remediation is not None
+                and not (remediation is not None and remediation.paused)
+            ):
+                updated = self._remediation.trip_for_slo(
+                    state, policy, common, analysis.abort_reason
+                )
+                if updated is not None:
+                    remediation = updated
+            # Adaptive write concurrency: the same AIMD scale that
+            # modulates wave size throttles the dispatcher's worker
+            # fan-out, so backpressure reaches the transport too.
+            self._set_write_concurrency_scale(analysis.wave_scale)
+
         # All phases run under one deferred-visibility barrier: node writes
         # land immediately, and their informer-cache visibility is awaited
         # once at the end — the next reconcile still never reads stale
@@ -831,7 +914,7 @@ class ClusterUpgradeStateManager:
             ),
             # 3. start upgrades up to the throttle (mode dispatch)
             lambda: self._process_upgrade_required_nodes_wrapper(
-                state, policy, remediation
+                state, policy, remediation, analysis
             ),
             # 4. cordon
             lambda: common.process_cordon_required_nodes(state),
@@ -967,15 +1050,45 @@ class ClusterUpgradeStateManager:
         for name in removed:
             state.node_states.setdefault(dest[name], []).append(index[name])
 
+    def _set_write_concurrency_scale(self, scale: float) -> None:
+        """Push the AIMD wave scale into the provider's write
+        dispatcher (adaptive write concurrency).  getattr-guarded for
+        injected duck-typed providers predating the surface."""
+        setter = getattr(self._provider, "set_write_concurrency_scale", None)
+        if setter is not None:
+            setter(scale)
+
     # ---------------------------------------------------- mode dispatchers
     def _process_upgrade_required_nodes_wrapper(
         self,
         state: ClusterUpgradeState,
         policy: UpgradePolicySpec,
         remediation: Optional[RemediationDecision] = None,
+        analysis: Optional["analysis_mod.AnalysisDecision"] = None,
     ) -> None:
         """Reference: ProcessUpgradeRequiredNodesWrapper (:287-297)."""
         if self._use_maintenance_operator and self._requestor is not None:
+            if analysis is not None and analysis.aborted:
+                # Aborted analysis: no new NodeMaintenance handoffs —
+                # the slow revision must not spread through the
+                # external operator either (the breaker's stance, with
+                # the SLO reason code).
+                logger.info(
+                    "analysis aborted; no new requestor handoffs (%s)",
+                    analysis.abort_reason,
+                )
+                events_mod.default_log().emit_many(
+                    events_mod.EVENT_NODE_DEFERRED,
+                    events_mod.REASON_SLO_GATE,
+                    [
+                        (ns.node.get("metadata") or {}).get("name") or ""
+                        for ns in state.nodes_in(
+                            consts.UPGRADE_STATE_UPGRADE_REQUIRED
+                        )
+                    ],
+                    "analysis aborted (requestor handoff paused)",
+                )
+                return
             if remediation is not None and remediation.paused:
                 # Breaker open: no new NodeMaintenance handoffs — the bad
                 # revision must not spread through the external operator
@@ -999,7 +1112,7 @@ class ClusterUpgradeStateManager:
             self._requestor.process_upgrade_required_nodes(state, policy)
         else:
             self.inplace.process_upgrade_required_nodes(
-                state, policy, remediation=remediation
+                state, policy, remediation=remediation, analysis=analysis
             )
 
     def _process_node_maintenance_required_nodes_wrapper(

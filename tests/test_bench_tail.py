@@ -64,6 +64,14 @@ def stubbed_probes(monkeypatch):
         "bench_profile_overhead",
         lambda *a, **k: {"profile_overhead_pct_1024n": 99999.99},
     )
+    monkeypatch.setattr(
+        bench,
+        "bench_analysis",
+        lambda *a, **k: {
+            "gate_eval_overhead_pct_1024n": 99999.99,
+            "pacing_convergence_s_1024n": 99999.99,
+        },
+    )
     frame = "x" * 32  # the trimmed-label ceiling bench emits
     monkeypatch.setattr(
         bench,
@@ -129,6 +137,11 @@ TRACKED_DETAIL_KEYS = (
     "http_pipeline_speedup",
     "http_vs_inmem_1024n",
     "profile_overhead_pct_1024n",
+    # the analysis-gate acceptance: the gate must stay inside the
+    # always-on-plane overhead budget, and the AIMD recovery latency
+    # is tracked per round
+    "gate_eval_overhead_pct_1024n",
+    "pacing_convergence_s_1024n",
     # the differential-profiling acceptance: the transport ratio must
     # arrive WITH the slow side's attributed frame list, not alone
     "profile_http_top",

@@ -362,6 +362,42 @@ class TestWatchAndLeaderMetrics:
         assert 'leader_transitions_total{event="released"} 1' in out
 
 
+class TestAnalysisGauges:
+    """PR 11: analysis-gate / adaptive-pacing exposition."""
+
+    def test_publish_and_retire(self, fresh_registry):
+        from k8s_operator_libs_tpu import metrics
+
+        metrics.publish_analysis_gauges(
+            {"canary-soak": metrics.ANALYSIS_STEP_PASSED,
+             "fleet": metrics.ANALYSIS_STEP_ACTIVE},
+            0.5,
+        )
+        metrics.record_pacing_adjustment("decrease")
+        out = fresh_registry.render()
+        assert 'analysis_gate_state{step="canary-soak"} 2' in out
+        assert 'analysis_gate_state{step="fleet"} 1' in out
+        assert "pacing_wave_scale 0.5" in out
+        assert 'pacing_adjustments_total{direction="decrease"} 1' in out
+        # retirement removes the series entirely (not zeroing): a
+        # retired gate stuck at 'aborted' would page forever
+        metrics.retire_analysis_gauges()
+        out = fresh_registry.render()
+        assert 'analysis_gate_state{step=' not in out
+        assert "pacing_wave_scale 0.5" not in out
+        # the adjustments counter, being a counter, survives
+        assert 'pacing_adjustments_total{direction="decrease"} 1' in out
+
+    def test_replace_drops_removed_steps(self, fresh_registry):
+        from k8s_operator_libs_tpu import metrics
+
+        metrics.publish_analysis_gauges({"a": 1.0, "b": 0.0}, 1.0)
+        metrics.publish_analysis_gauges({"a": 2.0}, 1.0)
+        out = fresh_registry.render()
+        assert 'analysis_gate_state{step="a"} 2' in out
+        assert 'step="b"' not in out
+
+
 class TestWritePipelineMetrics:
     def test_dispatcher_exposes_pipeline_family(self, fresh_registry):
         """A real dispatcher run lands `write_queue_depth`,
@@ -435,6 +471,9 @@ class TestAlertRulesStayInSync:
                 set(),
             )
             m.record_slo_breach("drainP99Seconds")
+            # analysis-gate / adaptive-pacing family (upgrade/analysis.py)
+            m.publish_analysis_gauges({"canary-soak": 1.0}, 1.0)
+            m.record_pacing_adjustment("decrease")
             # decision-audit family (obs/events.py)
             m.record_upgrade_event("NodeDeferred", "budget")
             # write-pipeline family (async batched write dispatcher)
