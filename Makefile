@@ -9,7 +9,7 @@ DOCKER ?= docker
 IMAGE ?= k8s-operator-libs-tpu:dev
 BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 
-.PHONY: all test test-fast lint bench bench-scale bench-http smoke graft-check cov \
+.PHONY: all test test-fast lint bench bench-scale bench-http bench-idle smoke graft-check cov \
 	cov-report clean help image .build-image kind-e2e kind-e2e-stub \
 	tpu-smoke tpu-probe tpu-watch tpu-stage verify verify-obs \
 	verify-remediation verify-slo verify-events verify-profile \
@@ -114,6 +114,13 @@ bench-scale:
 # without the full bench.
 bench-http:
 	$(PYTHON) bench.py --http-only
+
+# Event-driven steady-state probes only: idle-fleet reconcile cost
+# (polling vs event-driven), the 16,384-node node-flip reaction, and
+# the census-memo A/B — ONE compact JSON line, so the idle ~0/min and
+# sub-second-reaction targets are checkable without the full bench.
+bench-idle:
+	$(PYTHON) bench.py --idle-only
 
 # The minimum end-to-end slice: CRD apply/delete via the example CLI.
 smoke:

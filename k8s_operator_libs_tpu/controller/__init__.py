@@ -17,6 +17,7 @@ from .upgrade_reconciler import (
     UpgradeReconciler,
     new_upgrade_controller,
 )
+from .wakeup import WakeupSource
 from .workqueue import (
     ExponentialBackoffRateLimiter,
     RateLimitedQueue,
@@ -41,5 +42,6 @@ __all__ = [
     "ExponentialBackoffRateLimiter",
     "RateLimitedQueue",
     "ShutDown",
+    "WakeupSource",
     "WorkQueue",
 ]

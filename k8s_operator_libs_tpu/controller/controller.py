@@ -34,6 +34,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Hashable, Iterable, List, Optional, Protocol
 
+from .. import metrics
 from ..cluster.errors import ExpiredError
 from ..cluster.client import ClusterClient
 from ..cluster.inmem import JsonObj, WatchEvent
@@ -65,6 +66,12 @@ class Result:
 
     requeue: bool = False
     requeue_after: float = 0.0
+    #: wakeup-attribution label for the armed requeue_after delay:
+    #: ``fallback`` (a lost-event safety net, the default) or
+    #: ``deadline`` (the reconciler computed WHEN the next pass is due
+    #: — e.g. a maintenance-window opening).  Feeds
+    #: ``reconcile_wakeups_total{trigger}`` when the delay fires.
+    requeue_trigger: str = "fallback"
 
 
 class Reconciler(Protocol):
@@ -116,14 +123,42 @@ class Controller:
         queue: Optional[RateLimitedQueue] = None,
         event_sink=None,
         relist_sink=None,
+        idle_wait_seconds: Optional[float] = None,
     ) -> None:
         self._cluster = cluster
         self._reconciler = reconciler
         self.name = name
         self._poll = watch_poll_seconds
+        #: How long an IDLE watch loop blocks inside the cluster's
+        #: zero-latency journal wait (``wait_for_seq`` — a condition
+        #: variable in-mem, the server-held ``journalwait`` long-poll
+        #: over HTTP) before re-checking the stop flag.  A journal
+        #: write wakes the loop immediately regardless; this only
+        #: bounds shutdown latency and, on long-poll transports, the
+        #: idle request rate.  None = max(watch_poll_seconds, 0.05)
+        #: in-process, 0.5 s on remote transports (journalwait holds
+        #: the request server-side — short holds would re-issue it at
+        #: poll rate and defeat the long-poll).
+        if idle_wait_seconds is not None:
+            self._idle_wait = idle_wait_seconds
+        elif getattr(cluster, "transport_batching", False):
+            self._idle_wait = max(watch_poll_seconds, 0.5)
+        else:
+            self._idle_wait = max(watch_poll_seconds, 0.05)
         self._resync = resync_seconds
         self._max_retries = max_retries
         self._queue = queue or RateLimitedQueue()
+        # Wakeup attribution: every ACCEPTED enqueue of a reconcile
+        # request lands in reconcile_wakeups_total{trigger} — watch
+        # deltas, worker completions, deadline/fallback timers, resync.
+        # Only when the (possibly injected) queue has no listener of
+        # its own — an embedder's observer must not be clobbered.
+        if not getattr(self._queue, "has_wakeup_listener", False):
+            self._queue.set_wakeup_listener(
+                lambda _item, trigger: metrics.record_reconcile_wakeup(
+                    trigger
+                )
+            )
         #: Informer tee (single-reflector rule): on HTTP backends the
         #: watch stream is pop-once, so an InformerCache sharing this
         #: client must NOT consume it too.  *event_sink* receives every
@@ -138,6 +173,10 @@ class Controller:
         self._relist_sinks = _as_sinks(relist_sink)
         self._watches: List[_Watch] = []
         self._threads: List[threading.Thread] = []
+        #: exposed for WakeupSource assembly (upgrade_reconciler wires
+        #: async worker completions into the same queue the watch
+        #: feeds) — see controller/wakeup.py
+        self.queue = self._queue
         self._stop = threading.Event()
         self._started = False
         #: requests whose retry budget ran out (observable for tests/ops)
@@ -226,7 +265,11 @@ class Controller:
                     return True
             else:
                 quiet_since = None
-            time.sleep(0.005)
+            # the CONFIGURED poll interval, not a hardcoded 5 ms — a
+            # coarse-grained assembly (big watch_poll_seconds) must not
+            # busy-poll its own quiet check faster than its watch loop
+            # (floored so a poll of 0 cannot spin a core)
+            time.sleep(max(self._poll, 0.001))
         return False
 
     # ------------------------------------------------------------- internals
@@ -237,7 +280,7 @@ class Controller:
         for watch in self._watches:
             for obj in self._cluster.list(watch.kind):
                 for request in watch.mapper(obj):
-                    self._queue.add(request)
+                    self._queue.add(request, "list")
         self._last_seq = seq
         return seq
 
@@ -260,7 +303,7 @@ class Controller:
                 if use_held:
                     head = self._last_seq
                     self._cluster.wait_for_held_event(
-                        timeout=max(self._poll, 0.05)
+                        timeout=self._idle_wait
                     )
                 else:
                     # Take the journal head BEFORE scanning: kind-filtered
@@ -308,6 +351,30 @@ class Controller:
                     )
                 self._last_seq = max(self._last_seq, event.seq)
             self._last_seq = max(self._last_seq, head)
+            if events or use_held:
+                # a short coalescing window after a burst (and between
+                # held-stream drains, whose blocking wait sits at the
+                # loop top)
+                self._stop.wait(self._poll)
+            else:
+                # Idle, non-held: block on the journal itself instead
+                # of sleeping a fixed poll — in-mem this is a condition
+                # wait (zero-latency wake on the next write, ~0 idle
+                # cost); over HTTP it is the server-held `journalwait`
+                # long-poll (one held request instead of an
+                # events_since LIST per poll tick).  Bounded by
+                # idle_wait so stop() stays responsive.
+                self._idle_journal_wait()
+
+    def _idle_journal_wait(self) -> None:
+        waiter = getattr(self._cluster, "wait_for_seq", None)
+        if waiter is None:
+            self._stop.wait(self._poll)
+            return
+        try:
+            waiter(self._last_seq, timeout=self._idle_wait)
+        except Exception as err:  # noqa: BLE001 — thread boundary
+            logger.debug("%s: journal wait failed: %s", self.name, err)
             self._stop.wait(self._poll)
 
     def _fan_out(self, event: WatchEvent) -> None:
@@ -321,7 +388,7 @@ class Controller:
             if watch.predicate is not None and not watch.predicate(event):
                 continue
             for request in watch.mapper(obj):
-                self._queue.add(request)
+                self._queue.add(request, "watch")
 
     def _safe_relist(self) -> None:
         for sink in self._relist_sinks:
@@ -340,7 +407,7 @@ class Controller:
                 for watch in self._watches:
                     for obj in self._cluster.list(watch.kind):
                         for request in watch.mapper(obj):
-                            self._queue.add(request)
+                            self._queue.add(request, "resync")
             except Exception as err:  # noqa: BLE001 — thread boundary
                 logger.error("%s: resync failed: %s", self.name, err)
 
@@ -389,7 +456,16 @@ class Controller:
                     continue
                 if result is not None and result.requeue_after > 0:
                     self._queue.forget(request)
-                    self._queue.add_after(request, result.requeue_after)
+                    # the queue keeps only the earliest armed delay per
+                    # request, and any real event (watch / worker wake)
+                    # disarms it; the trigger says whether this was a
+                    # computed deadline or a lost-event safety net
+                    self._queue.add_after(
+                        request,
+                        result.requeue_after,
+                        getattr(result, "requeue_trigger", None)
+                        or "fallback",
+                    )
                 elif result is not None and result.requeue:
                     self._queue.add_rate_limited(request)
                 else:

@@ -16,17 +16,21 @@ SURVEY.md §3.2) and goes quiet when the fleet is steady.
 
 from __future__ import annotations
 
+import datetime
 import logging
+import time
 from dataclasses import dataclass, field
-from typing import Dict, Hashable, Iterable, Optional
+from typing import Dict, Hashable, Iterable, List, Optional
 
 from ..api.upgrade_spec import UpgradePolicySpec, ValidationError
 from ..cluster.errors import NotFoundError
 from ..cluster.client import ClusterClient
 from ..cluster.inmem import JsonObj
 from ..obs import tracing
+from ..upgrade import schedule
 from ..upgrade.upgrade_state import ClusterUpgradeStateManager
 from .controller import Controller, Result
+from .wakeup import WakeupSource
 
 logger = logging.getLogger(__name__)
 
@@ -115,11 +119,90 @@ class UpgradeReconciler:
     #: cadence would burn ~72k full-fleet snapshots through one hour of
     #: canarySoakSeconds doing no work
     gated_requeue_seconds: float = 5.0
+    #: Event-driven mode: journal deltas and async worker completions
+    #: SCHEDULE reconciles (the controller's watch tee + WakeupSource),
+    #: so the requeue delays above stop being the pickup mechanism and
+    #: become safety nets — the *_fallback_seconds cadences replace
+    #: them, and the gated branch computes the actual gate deadline
+    #: (window opening, pacing slot, canary soak expiry) instead of
+    #: polling.  Off (the default) preserves the poll-driven cadences
+    #: exactly — the reference consumers' behavior.
+    event_driven: bool = False
+    #: safety-net cadence while work is in flight: async completions
+    #: arrive as watch/worker wakeups, this only covers a lost event
+    active_fallback_seconds: float = 1.0
+    #: safety-net ceiling for the gated branch when no gate deadline is
+    #: computable (and the clamp for computed ones — clock-skew guard)
+    gated_fallback_seconds: float = 60.0
+    #: failed-only fleets wait on an external fix (watch-visible) or a
+    #: remediation backoff expiry; this bounds the pickup of the latter
+    failed_fallback_seconds: float = 60.0
 
     def _current_policy(self) -> Optional[UpgradePolicySpec]:
         if self.policy_source is not None:
             return self.policy_source.current()
         return self.policy
+
+    def _cadence(self, fallback: float, requeue: float) -> float:
+        """The event-driven demotion rule in one place: fallbacks are
+        the safety net when events schedule the passes, the poll
+        cadences otherwise."""
+        return fallback if self.event_driven else requeue
+
+    #: ceiling for a COMPUTED gate deadline (clock-skew guard: beyond
+    #: this we re-check rather than trust a far-future arithmetic)
+    MAX_GATE_DEADLINE_SECONDS = 3600.0
+
+    def _gate_deadline_seconds(self, state, policy) -> Optional[float]:
+        """Seconds until the earliest KNOWN gate re-opens, or None when
+        no gate deadline is computable (unknown gate — e.g. a frozen
+        canary waits on node events, not a clock).  Only consulted on
+        gated passes in event-driven mode, so the O(fleet) censuses
+        below run once per gate transition, not per poll tick."""
+        deadlines: List[float] = []
+        now = time.time()
+        mw = policy.maintenance_window
+        if mw is not None and not schedule.window_open(mw):
+            nxt = schedule.next_window_open(mw)
+            if nxt is not None:
+                deadlines.append(
+                    (
+                        nxt
+                        - datetime.datetime.now(datetime.timezone.utc)
+                    ).total_seconds()
+                )
+        limit = policy.max_nodes_per_hour or 0
+        if limit > 0:
+            slot_at = schedule.next_pacing_slot_at(
+                (ns.node for ns in state.all_node_states()), limit
+            )
+            if slot_at is not None:
+                deadlines.append(slot_at - now)
+        if policy.canary_domains > 0:
+            from ..upgrade.upgrade_inplace import canary_census
+
+            census = canary_census(state, policy)
+            if census.soak_until is not None:
+                deadlines.append(census.soak_until - now)
+        if not deadlines:
+            return None
+        return min(deadlines)
+
+    def _gated_result(self, state, policy) -> Result:
+        deadline = self._gate_deadline_seconds(state, policy)
+        if deadline is None:
+            return Result(requeue_after=self.gated_fallback_seconds)
+        # +50 ms so the gate is actually open when the pass runs;
+        # clamped into [0.05, MAX_GATE_DEADLINE] — a far-future window
+        # re-checks hourly rather than trusting one clock reading.
+        # trigger=deadline: this wakeup is a COMPUTED due time, not the
+        # lost-event safety net — the metric must tell them apart.
+        return Result(
+            requeue_after=max(
+                0.05, min(deadline + 0.05, self.MAX_GATE_DEADLINE_SECONDS)
+            ),
+            requeue_trigger="deadline",
+        )
 
     def reconcile(self, request: Hashable) -> Optional[Result]:
         state = self.manager.build_state(self.namespace, self.driver_labels)
@@ -155,8 +238,16 @@ class UpgradeReconciler:
         in_flight = common.get_upgrades_in_progress(
             state
         ) - common.get_upgrades_failed(state)
+        # Event-driven mode: every requeue below is a SAFETY NET — the
+        # watch tee and worker-completion wakeups schedule the real
+        # passes, the workqueue keeps only the earliest armed deadline
+        # per request, and any real wakeup disarms it.
         if in_flight > 0:
-            return Result(requeue_after=self.active_requeue_seconds)
+            return Result(
+                requeue_after=self._cadence(
+                    self.active_fallback_seconds, self.active_requeue_seconds
+                )
+            )
         if self.manager.last_apply_transitions:
             # The pass just MOVED nodes (e.g. admitted a wave): the
             # pre-transition snapshot still classifies them as pending-
@@ -164,14 +255,28 @@ class UpgradeReconciler:
             # the active cadence.  Watch events usually mask this; a
             # watch-less/poll-only assembly would otherwise pay the gated
             # interval per admission wave.
-            return Result(requeue_after=self.active_requeue_seconds)
+            return Result(
+                requeue_after=self._cadence(
+                    self.active_fallback_seconds, self.active_requeue_seconds
+                )
+            )
         if common.get_upgrades_pending(state):
             # Pending with nothing in flight AND no transitions this
             # pass = gated admissions (canary bake, closed window,
-            # exhausted pacing) — requeue at the gated cadence.
+            # exhausted pacing).  Event-driven: requeue AT the computed
+            # gate deadline (window opening / pacing slot / soak
+            # expiry) instead of polling the gated cadence — a
+            # canary-soaking fleet costs zero passes until the bake
+            # window ends.
+            if self.event_driven:
+                return self._gated_result(state, policy)
             return Result(requeue_after=self.gated_requeue_seconds)
         if common.get_upgrades_failed(state):
-            return Result(requeue_after=self.failed_requeue_seconds)
+            return Result(
+                requeue_after=self._cadence(
+                    self.failed_fallback_seconds, self.failed_requeue_seconds
+                )
+            )
         return None
 
 
@@ -191,6 +296,11 @@ def new_upgrade_controller(
     watch_poll_seconds: float = 0.005,
     feed_cache=None,
     feed_index=None,
+    event_driven: bool = True,
+    active_fallback_seconds: float = 1.0,
+    gated_fallback_seconds: float = 60.0,
+    failed_fallback_seconds: float = 60.0,
+    idle_wait_seconds: Optional[float] = None,
 ) -> Controller:
     """Assemble the standard operator: watches on Nodes, driver Pods,
     DaemonSets (and NodeMaintenance when requestor mode needs it via
@@ -212,7 +322,17 @@ def new_upgrade_controller(
     (ControllerRevision, NodeMaintenance, ...) are added with a
     no-request mapper when not already watched.  Usually this is
     ``manager.state_index`` from a manager built with
-    ``use_state_index=True``."""
+    ``use_state_index=True``.
+
+    *event_driven* (default True): journal deltas and async worker
+    completions SCHEDULE the reconciles — a :class:`WakeupSource`
+    bound to the controller's queue is handed to the manager so
+    drain/eviction workers wake the loop the moment they finish, and
+    the requeue cadences above are demoted to safety-net fallbacks
+    (``*_fallback_seconds``; the gated branch requeues at the computed
+    gate deadline).  An idle or fully-gated fleet then performs ~zero
+    reconcile passes, at any size.  Pass False to restore the pure
+    poll-driven cadences (the reference consumers' behavior)."""
     if (policy is None) == (policy_source is None):
         raise ValueError("pass exactly one of policy / policy_source")
     if policy_source is not None and not callable(
@@ -232,6 +352,10 @@ def new_upgrade_controller(
         active_requeue_seconds=active_requeue_seconds,
         failed_requeue_seconds=failed_requeue_seconds,
         gated_requeue_seconds=gated_requeue_seconds,
+        event_driven=event_driven,
+        active_fallback_seconds=active_fallback_seconds,
+        gated_fallback_seconds=gated_fallback_seconds,
+        failed_fallback_seconds=failed_fallback_seconds,
     )
     event_sinks = []
     relist_sinks = []
@@ -249,7 +373,17 @@ def new_upgrade_controller(
         watch_poll_seconds=watch_poll_seconds,
         event_sink=event_sinks or None,
         relist_sink=relist_sinks or None,
+        idle_wait_seconds=idle_wait_seconds,
     )
+    if event_driven:
+        # Async worker completions (drain/eviction label writes, the
+        # write pipeline's completion callbacks) signal the SAME queue
+        # the watch tee feeds — the pass that picks their results up is
+        # scheduled at completion time, not at the next poll tick.
+        wakeup = WakeupSource(controller.queue, UPGRADE_REQUEST)
+        attach = getattr(manager, "set_wakeup_source", None)
+        if attach is not None:
+            attach(wakeup)
     kinds = ["Node", "Pod", "DaemonSet", *extra_kinds]
     if policy_source is not None:
         kinds.append(POLICY_KIND)

@@ -499,6 +499,27 @@ def publish_rollout_gauges(
     reg.gauge("upgrades_done", "Nodes at the target revision.").set(done)
 
 
+def record_reconcile_wakeup(trigger: str) -> None:
+    """A reconcile request was ACCEPTED onto the workqueue (fresh
+    enqueue or a coalescing dirty-mark on an in-flight request), by
+    wakeup trigger: ``watch`` (journal delta), ``worker`` (async
+    drain/eviction/write completion), ``deadline`` (a computed gate
+    deadline fired), ``fallback`` (safety-net requeue timer),
+    ``retry`` (backoff after a failed reconcile), ``resync``
+    (periodic list), ``list`` (initial/relist enqueue).  Dedup'd adds
+    (the request is already queued) are NOT counted — the series
+    measures scheduled passes, so an idle event-driven fleet holds it
+    flat and a storm with no cluster changes is alertable
+    (UpgradeReconcileStorm)."""
+    default_registry().counter(
+        "reconcile_wakeups_total",
+        "Reconcile requests accepted onto the workqueue, by wakeup "
+        "trigger (watch | worker | deadline | fallback | retry | "
+        "resync | list | direct).",
+        ("trigger",),
+    ).inc(trigger)
+
+
 def record_watch_reconnect(kind: str) -> None:
     """A held watch stream reconnected (hold expiry or transport error)."""
     default_registry().counter(

@@ -93,6 +93,22 @@ class ClusterUpgradeState:
     #: the manager then ACKs the index's dirty debt once an ApplyState
     #: pass over it completes.  Excluded from equality like dirty_nodes.
     built_from_index: bool = field(default=False, compare=False)
+    #: Census memo: the flattened managed-node list, built once per
+    #: snapshot and shared by every fleet walk of the pass (slot math,
+    #: pacing/canary censuses, remediation, analysis exposure, SLO
+    #: evaluation).  Before the memo each of those rebuilt the list —
+    #: ~6-8 full O(fleet) comprehensions per reconcile, the dominant
+    #: reconcile frames at 65k nodes once event-driven wakeups removed
+    #: the idle passes.  Invalidated by cascade bucket migration (the
+    #: only within-pass bucket mutation).  Excluded from equality.
+    _managed_memo: Optional[List[NodeUpgradeState]] = field(
+        default=None, repr=False, compare=False
+    )
+    #: Same memo for the ALL-buckets flatten (pacing/quarantine scans,
+    #: the slice-mode domain total, the cascade bucket index).
+    _all_memo: Optional[List[NodeUpgradeState]] = field(
+        default=None, repr=False, compare=False
+    )
 
     def nodes_in(self, state: str) -> List[NodeUpgradeState]:
         return self.node_states.get(state, [])
@@ -119,7 +135,15 @@ class ClusterUpgradeState:
         ]
 
     def all_node_states(self) -> List[NodeUpgradeState]:
-        return [ns for states in self.node_states.values() for ns in states]
+        """Every bucket flattened — memoized per snapshot like
+        :meth:`managed_node_states`; callers iterate, never mutate."""
+        memo = self._all_memo
+        if memo is None:
+            memo = [
+                ns for states in self.node_states.values() for ns in states
+            ]
+            self._all_memo = memo
+        return memo
 
     def managed_node_states(self) -> List[NodeUpgradeState]:
         """Node states in *recognized* buckets only.  A node whose state
@@ -127,13 +151,36 @@ class ClusterUpgradeState:
         math so it cannot permanently consume throttle slots (the
         reference's GetTotalManagedNodes likewise sums only known buckets,
         common_manager.go:712-728; unlike the reference we also count the
-        two maintenance states so requestor-delegated nodes hold slots)."""
-        return [
-            ns
+        two maintenance states so requestor-delegated nodes hold slots).
+
+        Memoized per snapshot — callers share ONE flattened list and
+        must not mutate it (every caller iterates).  Bucket mutation
+        (cascade migration) calls :meth:`invalidate_census`."""
+        memo = self._managed_memo
+        if memo is None:
+            memo = [
+                ns
+                for state, nss in self.node_states.items()
+                if state in consts.ALL_STATES
+                for ns in nss
+            ]
+            self._managed_memo = memo
+        return memo
+
+    def total_managed_nodes(self) -> int:
+        """Managed-node COUNT via per-bucket lengths — O(buckets), no
+        list materialization (the pure-census callers' fast path)."""
+        return sum(
+            len(nss)
             for state, nss in self.node_states.items()
             if state in consts.ALL_STATES
-            for ns in nss
-        ]
+        )
+
+    def invalidate_census(self) -> None:
+        """Drop the flatten memos after a bucket mutation (cascade
+        bucket migration is the one in-pass mutator)."""
+        self._managed_memo = None
+        self._all_memo = None
 
 
 class CommonUpgradeManager:
@@ -584,8 +631,11 @@ class CommonUpgradeManager:
 
     # ------------------------------------------------------------------ census
     def get_total_managed_nodes(self, state: ClusterUpgradeState) -> int:
-        """Reference: GetTotalManagedNodes (:712-728) — known buckets only."""
-        return len(state.managed_node_states())
+        """Reference: GetTotalManagedNodes (:712-728) — known buckets
+        only.  Counted from per-bucket lengths, not a flattened list —
+        this runs several times per pass (slot math, gauges, the
+        reconciler's cadence decision)."""
+        return state.total_managed_nodes()
 
     def get_upgrades_in_progress(self, state: ClusterUpgradeState) -> int:
         """Reference: GetUpgradesInProgress (:730-737) — everything not

@@ -369,6 +369,30 @@ class DrainConfiguration:
 DEFAULT_WORKER_POOL_SIZE = 32
 
 
+class CompletionWakeupMixin:
+    """Event-driven reconcile hook shared by the async node-worker
+    managers (drain, pod eviction): the assembly attaches a zero-arg
+    callback (``WakeupSource.wake``) via :meth:`set_wakeup`, and each
+    worker calls :meth:`_signal_wakeup` after its terminal state write
+    lands — the reconcile that picks the result up is then scheduled at
+    completion time, not at the next fallback tick."""
+
+    _wakeup = None
+
+    def set_wakeup(self, wakeup) -> None:
+        """Attach a zero-arg completion callback (WakeupSource.wake)."""
+        self._wakeup = wakeup
+
+    def _signal_wakeup(self) -> None:
+        wakeup = self._wakeup
+        if wakeup is None:
+            return
+        try:
+            wakeup()
+        except Exception as err:  # noqa: BLE001 — worker boundary
+            logger.debug("worker completion wakeup failed: %s", err)
+
+
 def default_worker_pool_size() -> int:
     """Drain/pod worker pool width: scales with the MACHINE, not the
     fleet.  Every Python worker thread is GIL/scheduler pressure, and
@@ -379,10 +403,11 @@ def default_worker_pool_size() -> int:
     return max(4, min(DEFAULT_WORKER_POOL_SIZE, 4 * (os.cpu_count() or 4)))
 
 
-class DrainManager:
+class DrainManager(CompletionWakeupMixin):
     """Schedules node drains on a BOUNDED worker pool (the reference's
     goroutines, with a cap); results are written via the state provider
-    and picked up by the *next* reconcile."""
+    and picked up by the *next* reconcile (scheduled at completion time
+    when a wakeup hook is attached — CompletionWakeupMixin)."""
 
     def __init__(
         self,
@@ -533,6 +558,7 @@ class DrainManager:
                     "failed to update state for node %s: %s", name, err
                 )
             self._in_flight.remove(name)
+            self._signal_wakeup()
 
         # Async when the provider can (pipelined manager over a
         # batching transport): the worker thread is released to the
@@ -553,6 +579,7 @@ class DrainManager:
                 "failed to update state for node %s: %s", name, err
             )
             self._in_flight.remove(name)
+            self._signal_wakeup()
             return
         try:
             self._provider.change_node_upgrade_state(node, state)
@@ -562,3 +589,4 @@ class DrainManager:
             )
         finally:
             self._in_flight.remove(name)
+            self._signal_wakeup()

@@ -39,7 +39,11 @@ from ..cluster.objects import (
 from ..cluster.writepipeline import WriteOp, transport_batch_fn
 from ..obs import tracing
 from . import consts, util
-from .drain_manager import DrainHelper, DrainHelperConfig
+from .drain_manager import (
+    CompletionWakeupMixin,
+    DrainHelper,
+    DrainHelperConfig,
+)
 from .node_upgrade_state_provider import NodeUpgradeStateProvider
 from .util import EventRecorder, StringSet, log_event
 
@@ -64,7 +68,7 @@ class PodManagerConfig:
     drain_enabled: bool = False
 
 
-class PodManager:
+class PodManager(CompletionWakeupMixin):
     def __init__(
         self,
         cluster: ClusterClient,
@@ -296,6 +300,9 @@ class PodManager:
             self._change_state(node, consts.UPGRADE_STATE_POD_RESTART_REQUIRED)
         finally:
             self._nodes_in_progress.remove(name)
+            # async worker completion: wake the reconcile loop so the
+            # result is picked up now, not at the next fallback tick
+            self._signal_wakeup()
 
     def _update_node_to_drain_or_failed(
         self, node: JsonObj, drain_enabled: bool

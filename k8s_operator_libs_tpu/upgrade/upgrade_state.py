@@ -230,6 +230,29 @@ class ClusterUpgradeStateManager:
         #: contract (same stance as /debug/slo).
         self._last_state: Optional[ClusterUpgradeState] = None
         self._last_policy: Optional[UpgradePolicySpec] = None
+        #: Event-driven reconcile hook (controller/wakeup.py): when the
+        #: assembly attaches a WakeupSource, async worker completions
+        #: (drain/eviction) wake the reconcile loop at completion time
+        #: instead of waiting for the next requeue tick.
+        self._wakeup = None
+
+    def set_wakeup_source(self, wakeup) -> None:
+        """Attach the controller's :class:`~..controller.WakeupSource`
+        so async drain/eviction worker completions schedule the next
+        reconcile the moment their state writes land (their journal
+        events wake the watch too — this skips even the watch loop's
+        drain latency, and covers watch-less assemblies)."""
+        self._wakeup = wakeup
+
+        def _wake() -> None:
+            # no guard here: each manager's _signal_wakeup already
+            # wraps the call in its worker-boundary envelope
+            wakeup.wake("worker")
+
+        for mgr in (self._drain_manager, self._pod_manager):
+            setter = getattr(mgr, "set_wakeup", None)
+            if setter is not None:
+                setter(_wake)
 
     def shutdown(self, wait: bool = True) -> None:
         """Release the worker-pool threads this manager owns.  Long-lived
@@ -1049,6 +1072,8 @@ class ClusterUpgradeStateManager:
                 state.node_states[bucket] = kept
         for name in removed:
             state.node_states.setdefault(dest[name], []).append(index[name])
+        # bucket membership moved: the managed-node census memo is stale
+        state.invalidate_census()
 
     def _set_write_concurrency_scale(self, scale: float) -> None:
         """Push the AIMD wave scale into the provider's write

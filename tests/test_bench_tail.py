@@ -23,6 +23,9 @@ def stubbed_probes(monkeypatch):
     """Replace every fleet/hardware probe with instant fakes returning
     worst-case-width measurements, keeping main()'s REAL key assembly
     (scale_section/engine A/B/HTTP ratios all run their actual code)."""
+    # the tail contract is environment-independent: the 65k probe's
+    # skip knob must not hide its (stubbed, instant) keys here
+    monkeypatch.delenv("BENCH_SKIP_65536", raising=False)
     walls = iter([9999.99, 99.99] * 200)
 
     def fake_rollout(*args, **kwargs):
@@ -63,6 +66,46 @@ def stubbed_probes(monkeypatch):
         bench,
         "bench_profile_overhead",
         lambda *a, **k: {"profile_overhead_pct_1024n": 99999.99},
+    )
+    frame32 = "x" * 32
+    monkeypatch.setattr(
+        bench,
+        "bench_event_driven",
+        lambda *a, **k: {
+            "idle_reconciles_per_min_1024n": 99999.99,
+            "idle_reconciles_per_min_polling_1024n": 99999.99,
+            "idle_list_ops_1024n": 9999999,
+            "node_flip_reaction_ms_16384n": 99999.9,
+            "profile_idle_poll_top": {
+                f"{frame32[:-1]}{i}": 99.9 for i in range(3)
+            },
+            "profile_idle_removed": [
+                {
+                    "frame": "y" * 40,
+                    "old_pct": 99.99,
+                    "new_pct": 99.99,
+                    "delta_pct": 99.99,
+                }
+            ]
+            * 5,
+        },
+    )
+    monkeypatch.setattr(
+        bench,
+        "bench_census_memo",
+        lambda *a, **k: {
+            "census_memo_speedup_1024n": 99999.999,
+            "census_cycle_ms_1024n": 99999.99,
+            "profile_census_removed": [
+                {
+                    "frame": "y" * 40,
+                    "old_pct": 99.99,
+                    "new_pct": 99.99,
+                    "delta_pct": 99.99,
+                }
+            ]
+            * 5,
+        },
     )
     monkeypatch.setattr(
         bench,
@@ -145,6 +188,16 @@ TRACKED_DETAIL_KEYS = (
     # the differential-profiling acceptance: the transport ratio must
     # arrive WITH the slow side's attributed frame list, not alone
     "profile_http_top",
+    # event-driven reconcile acceptance (ISSUE 12): idle-fleet cost
+    # ~0/min (with the polling yardstick beside it), sub-second
+    # node-flip reaction at 16,384 nodes, the 65k scale probe's
+    # retention, and the census-memo incremental-ization ratio
+    "idle_reconciles_per_min_1024n",
+    "idle_reconciles_per_min_polling_1024n",
+    "node_flip_reaction_ms_16384n",
+    "scale_65536_nodes_per_min",
+    "scale_retention_65536_vs_8192",
+    "census_memo_speedup_1024n",
 )
 
 

@@ -1055,3 +1055,299 @@ class TestInformerTee:
             )
         finally:
             c.stop()
+
+
+class TestDeadlineAwareQueue:
+    """ISSUE 12: the workqueue keeps at most ONE live deadline per item
+    (earliest wins) and an immediate add disarms it — the reconciler's
+    requeue timers are safety nets, not the scheduling mechanism."""
+
+    def test_later_arm_is_noop_earlier_supersedes(self):
+        q = RateLimitedQueue()
+        q.add_after("a", 0.4)
+        q.add_after("a", 5.0)  # later than the armed one: no-op
+        assert q.pending_work() == 1  # ONE live deadline, not a heap count
+        q.add_after("a", 0.05)  # earlier: supersedes
+        t0 = time.monotonic()
+        assert q.get(1.0) == "a"
+        assert time.monotonic() - t0 < 0.3  # delivered at ~0.05, not 0.4
+        q.done("a")
+        # neither superseded entry ever fires
+        assert q.get(0.6) is None
+        q.shutdown()
+
+    def test_immediate_add_disarms_pending_deadline(self):
+        q = RateLimitedQueue()
+        q.add_after("a", 0.15)
+        q.add("a")  # a real wakeup: the safety net is obsolete
+        assert q.get(0.1) == "a"
+        q.done("a")
+        assert q.get(0.35) is None  # the 0.15s deadline never fires
+        q.shutdown()
+
+    def test_wakeup_listener_counts_accepted_adds_only(self):
+        seen = []
+        q = RateLimitedQueue(
+            wakeup_listener=lambda _item, trigger: seen.append(trigger)
+        )
+        assert q.add("a", "watch") is True
+        assert q.add("a", "watch") is False  # dedup'd: not counted
+        assert seen == ["watch"]
+        item = q.get(0.1)
+        assert q.add("a", "worker") is True  # dirty-mark: one more pass
+        assert q.add("a", "worker") is False  # coalesces into the same
+        q.done(item)
+        assert seen == ["watch", "worker"]
+        q.shutdown()
+
+    def test_delayed_fire_reports_its_trigger(self):
+        seen = []
+        q = RateLimitedQueue(
+            wakeup_listener=lambda _item, trigger: seen.append(trigger)
+        )
+        q.add_after("a", 0.01, "fallback")
+        assert q.get(1.0) == "a"
+        assert seen == ["fallback"]
+        q.done("a")
+        q.shutdown()
+
+
+class TestWaitQuietPoll:
+    def test_wait_quiet_polls_at_configured_interval(self, monkeypatch):
+        """Regression (ISSUE 12 satellite): wait_quiet busy-polled at a
+        hardcoded 5 ms regardless of watch_poll_seconds; it must ride
+        the configured interval."""
+        from k8s_operator_libs_tpu.controller import controller as ctrl_mod
+
+        cluster = InMemoryCluster()
+
+        class R:
+            def reconcile(self, request):
+                return None
+
+        c = Controller(cluster, R(), watch_poll_seconds=0.05)
+        sleeps = []
+        real_sleep = time.sleep
+        monkeypatch.setattr(
+            ctrl_mod.time,
+            "sleep",
+            lambda s: (sleeps.append(s), real_sleep(0.001)),
+        )
+        assert c.wait_quiet(0.3, settle=0.1)
+        assert sleeps, "wait_quiet never polled"
+        assert all(s == pytest.approx(0.05) for s in sleeps)
+
+
+def _wakeup_count(trigger: str) -> float:
+    from k8s_operator_libs_tpu import metrics as metrics_mod
+
+    for metric in metrics_mod.default_registry().collect():
+        if metric.name.endswith("reconcile_wakeups_total"):
+            return metric.value(trigger)
+    return 0.0
+
+
+class TestEventDrivenWakeups:
+    """ISSUE 12 tentpole: journal deltas SCHEDULE reconciles — an idle
+    fleet performs zero passes over a multi-interval window, and a
+    single node change triggers exactly one coalesced pass (asserted
+    via reconcile_wakeups_total{trigger} and InMemoryCluster.list_ops)."""
+
+    def _assemble(self, cluster, policy, **kwargs):
+        manager = ClusterUpgradeStateManager(
+            cluster,
+            cache_sync_timeout_seconds=2.0,
+            cache_sync_poll_seconds=0.01,
+        )
+        ctrl = new_upgrade_controller(
+            cluster,
+            manager,
+            NAMESPACE,
+            DRIVER_LABELS,
+            policy,
+            resync_seconds=0.0,
+            event_driven=True,
+            **kwargs,
+        )
+        passes = []
+        inner = ctrl._reconciler
+
+        class Counting:
+            def reconcile(self, request):
+                passes.append(time.monotonic())
+                return inner.reconcile(request)
+
+        ctrl._reconciler = Counting()
+        return ctrl, manager, passes
+
+    def test_idle_fleet_zero_passes_then_flip_one_pass(self, cluster):
+        from k8s_operator_libs_tpu.upgrade import util as upgrade_util
+
+        state_key = upgrade_util.get_upgrade_state_label_key()
+        fleet = Fleet(cluster, revision_hash="v1")
+        for h in range(3):
+            fleet.add_node(
+                f"host{h}", labels={state_key: consts.UPGRADE_STATE_DONE}
+            )
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            drain_spec=DrainSpec(enable=True, force=True),
+        )
+        ctrl, manager, passes = self._assemble(cluster, policy)
+        ctrl.start()
+        try:
+            assert ctrl.wait_quiet(5.0)
+            settled = len(passes)
+            lists_before = cluster.list_ops
+            watch_before = _wakeup_count("watch")
+            # A multi-interval window: 10x the old 0.05 s active
+            # cadence, 2 intervals of a 0.25 s poll — the poll-driven
+            # reconciler would have run 5-10 passes here.
+            time.sleep(0.5)
+            assert len(passes) == settled, "idle fleet still reconciling"
+            assert cluster.list_ops == lists_before, (
+                "idle fleet paid store LISTs with no reconcile pending"
+            )
+            assert _wakeup_count("watch") == watch_before
+            # One node change: a label write the watch maps onto the
+            # upgrade request — exactly one wakeup, one coalesced pass.
+            cluster.patch(
+                "Node", "host0", {"metadata": {"labels": {"probe": "1"}}}
+            )
+            deadline = time.monotonic() + 3.0
+            while len(passes) == settled and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert len(passes) == settled + 1, "flip did not wake exactly once"
+            assert _wakeup_count("watch") == watch_before + 1
+            # ...and only one: the fleet is still done, no requeue armed
+            time.sleep(0.3)
+            assert len(passes) == settled + 1
+        finally:
+            ctrl.stop()
+            manager.shutdown(wait=False)
+
+    def test_gated_fleet_requeues_at_gate_deadline(self, cluster):
+        """Event-driven mode: a gated pass requeues AT the computed gate
+        deadline (closed maintenance window -> its opening, clamped to
+        the 1 h re-check ceiling), not at the 5 s gated poll."""
+        import datetime as _dt
+
+        from k8s_operator_libs_tpu.api.upgrade_spec import (
+            MaintenanceWindowSpec,
+        )
+        from k8s_operator_libs_tpu.controller.upgrade_reconciler import (
+            UpgradeReconciler,
+        )
+
+        fleet = Fleet(cluster, revision_hash="v1")
+        fleet.add_node("host0")
+        fleet.publish_new_revision("v2")
+        manager = ClusterUpgradeStateManager(
+            cluster,
+            cache_sync_timeout_seconds=2.0,
+            cache_sync_poll_seconds=0.01,
+        )
+        start = (
+            _dt.datetime.now(_dt.timezone.utc) + _dt.timedelta(hours=6)
+        ).strftime("%H:00")
+        rec = UpgradeReconciler(
+            manager=manager,
+            namespace=NAMESPACE,
+            driver_labels=DRIVER_LABELS,
+            policy=UpgradePolicySpec(
+                auto_upgrade=True,
+                max_parallel_upgrades=1,
+                maintenance_window=MaintenanceWindowSpec(
+                    start=start, duration_minutes=60
+                ),
+                drain_spec=DrainSpec(enable=True, force=True),
+            ),
+            event_driven=True,
+            gated_requeue_seconds=5.0,
+        )
+        rec.reconcile("upgrade-cycle")  # classification pass
+        result = rec.reconcile("upgrade-cycle")  # steady gated pass
+        assert manager.last_apply_transitions == 0
+        assert result is not None
+        # the window opens in ~5-6 h: far past the gated poll, clamped
+        # to the hourly re-check ceiling
+        assert result.requeue_after > 60.0
+        assert result.requeue_after <= rec.MAX_GATE_DEADLINE_SECONDS
+        manager.shutdown(wait=False)
+
+    def test_in_flight_uses_fallback_cadence(self, cluster):
+        """Event-driven mode: the active requeue is a SAFETY NET at the
+        fallback cadence — worker completions and watch deltas are the
+        real pickup mechanism."""
+        from k8s_operator_libs_tpu.controller.upgrade_reconciler import (
+            UpgradeReconciler,
+        )
+
+        fleet = Fleet(cluster, revision_hash="v1")
+        for h in range(2):
+            fleet.add_node(f"host{h}")
+        fleet.publish_new_revision("v2")
+        manager = ClusterUpgradeStateManager(
+            cluster,
+            cache_sync_timeout_seconds=2.0,
+            cache_sync_poll_seconds=0.01,
+        )
+        rec = UpgradeReconciler(
+            manager=manager,
+            namespace=NAMESPACE,
+            driver_labels=DRIVER_LABELS,
+            policy=UpgradePolicySpec(
+                auto_upgrade=True,
+                max_parallel_upgrades=1,
+                drain_spec=DrainSpec(enable=True, force=True),
+            ),
+            active_requeue_seconds=0.02,
+            event_driven=True,
+            active_fallback_seconds=1.5,
+        )
+        result = rec.reconcile("upgrade-cycle")
+        assert manager.last_apply_transitions > 0
+        assert result is not None
+        assert result.requeue_after == pytest.approx(1.5)
+        manager.shutdown(wait=False)
+
+    def test_worker_completion_wakes_reconcile(self, cluster):
+        """The WakeupSource contract: an async drain worker completion
+        enqueues the reconcile key with trigger=worker."""
+        from k8s_operator_libs_tpu.controller import (
+            UPGRADE_REQUEST,
+            RateLimitedQueue,
+            WakeupSource,
+        )
+
+        fleet = Fleet(cluster, revision_hash="v1")
+        fleet.add_node("host0")
+        manager = ClusterUpgradeStateManager(
+            cluster,
+            cache_sync_timeout_seconds=2.0,
+            cache_sync_poll_seconds=0.01,
+        )
+        seen = []
+        q = RateLimitedQueue(
+            wakeup_listener=lambda _item, trigger: seen.append(trigger)
+        )
+        manager.set_wakeup_source(WakeupSource(q, UPGRADE_REQUEST))
+        node = cluster.get("Node", "host0")
+        # drive a real drain through the manager's drain workers
+        from k8s_operator_libs_tpu.upgrade.drain_manager import (
+            DrainConfiguration,
+        )
+
+        manager.drain_manager.schedule_nodes_drain(
+            DrainConfiguration(
+                spec=DrainSpec(enable=True, force=True, timeout_second=10),
+                nodes=[node],
+            )
+        )
+        assert manager.drain_manager.wait_idle(10.0)
+        deadline = time.monotonic() + 2.0
+        while "worker" not in seen and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert "worker" in seen
+        q.shutdown()
+        manager.shutdown(wait=False)

@@ -476,6 +476,8 @@ class TestAlertRulesStayInSync:
             m.record_pacing_adjustment("decrease")
             # decision-audit family (obs/events.py)
             m.record_upgrade_event("NodeDeferred", "budget")
+            # event-driven reconcile family (controller/wakeup.py)
+            m.record_reconcile_wakeup("watch")
             # write-pipeline family (async batched write dispatcher)
             m.write_queue_depth_gauge().set(0)
             m.http_inflight_writes_gauge().set(0)
