@@ -1540,6 +1540,38 @@ def remediation_section(slices: int = 256, hosts: int = 4) -> dict:
     }
 
 
+def chaos_section(seed: int = 0, fleet: int = 8) -> dict:
+    """The resilience scorecard (upgrade/chaos.py): the full default
+    chaos campaign — 12 fault scenarios × transport/gates axes, every
+    cell replayed from a seed and checked by the rollout-invariant
+    checker against the decision stream — so a regression in
+    *resilience* shows up in the bench tail exactly like a regression
+    in speed (cells_passed drops below cells_total and the failed cells
+    are named in the full artifact).  ``BENCH_SKIP_CHAOS=1`` skips."""
+    if os.environ.get("BENCH_SKIP_CHAOS"):
+        return {"chaos_cells_total": 0, "chaos_skipped": True}
+    import logging as logging_mod
+
+    from k8s_operator_libs_tpu.upgrade import chaos as chaos_mod
+
+    # absorbed-fault warnings are the scenarios working as intended;
+    # they would drown the bench's stdout artifact
+    chaos_logger = logging_mod.getLogger("k8s_operator_libs_tpu")
+    prev_level = chaos_logger.level
+    chaos_logger.setLevel(logging_mod.ERROR)
+    try:
+        scorecard = chaos_mod.run_campaign(
+            chaos_mod.Campaign(seed=seed, fleet_size=fleet)
+        )
+    finally:
+        chaos_logger.setLevel(prev_level)
+    out = chaos_mod.compact_scorecard(scorecard)
+    # the full per-cell detail rides only the pretty artifact (the
+    # compact tail sheds lists anyway)
+    out["chaos_cells"] = scorecard["cells"]
+    return out
+
+
 def bench_policies() -> tuple:
     """(reference-defaults policy, tuned slice-aware policy) — ONE
     definition shared by the headline bench and ``--profile`` so the
@@ -1622,6 +1654,10 @@ def main() -> None:
     # ---- remediation: breaker-trip → LKG-rollback MTTR at 1,024 nodes
     remediation = remediation_section()
 
+    # ---- resilience scorecard: the default chaos campaign (12 fault
+    # scenarios × transport/gates axes, invariant-checked per cell)
+    chaos = chaos_section()
+
     # ---- event-driven reconcile acceptance: idle-fleet passes/min
     # (polling vs event-driven, profile-diffed), node-flip reaction at
     # 16,384 nodes, and the census-memo incremental-ization A/B
@@ -1696,6 +1732,7 @@ def main() -> None:
                     "inmem_nodes_per_min": round(tuned_rate, 2),
                     **scale,
                     **remediation,
+                    **{k: v for k, v in chaos.items() if k != "chaos_cells"},
                     **event_driven,
                     **census,
                     "engine": {
@@ -1761,6 +1798,9 @@ def main() -> None:
                     "baseline_wall_s": round(baseline_s, 2),
                     "tuned_wall_s": round(tuned_s, 2),
                     "informer_lag_s": INFORMER_LAG_S,
+                    # full per-cell chaos detail: pretty artifact only
+                    # (the compact prune drops lists)
+                    "chaos_cells": chaos.get("chaos_cells", []),
                     "tpu": tpu_section(),
                     "compute_cpu": compute_cpu_section(),
                 },
@@ -1794,6 +1834,8 @@ COMPACT_SHED_FIRST = (
     "profile_idle_poll_top",
     "idle_list_ops_1024n",
     "census_cycle_ms_1024n",
+    "chaos_wall_s",
+    "chaos_violations",
     "scale_65536_wall_s",
     "engine.idx_on_512n_wall_s",
     "engine.idx_off_512n_wall_s",
@@ -1815,6 +1857,13 @@ COMPACT_SHED_FIRST = (
     "scale_4096_default_gc_nodes_per_min",
     "profile_engine_off_top",
     "fleet",
+    # derivable / yardstick twins of tracked ratios: shed before the
+    # end-guard can reach the tracked keys or the tpu/compute evidence
+    "inmem_lagged_1024_nodes_per_min",
+    "http_vs_inmem_ceiling_1024n",
+    "baseline_config_nodes_per_min",
+    "policy_vs_default",
+    "informer_lag_s",
 )
 
 

@@ -48,6 +48,17 @@ Subcommands:
       python -m k8s_operator_libs_tpu pacing --state-file dump.json --policy p
       python -m k8s_operator_libs_tpu pacing --selftest   # make verify-pacing
 
+* ``chaos`` — the chaos campaign engine (:mod:`.upgrade.chaos`):
+  declarative fault-scenario sweeps crossed with config axes, every
+  cell checked by the rollout-invariant checker against the decision
+  stream; prints the resilience scorecard.
+
+      python -m k8s_operator_libs_tpu chaos --list
+      python -m k8s_operator_libs_tpu chaos --seed 7 --json
+      python -m k8s_operator_libs_tpu chaos --scenario apiserver-brownout
+      python -m k8s_operator_libs_tpu chaos --campaign nightly.json
+      python -m k8s_operator_libs_tpu chaos --selftest   # make verify-chaos
+
 * ``profile`` — the continuous profiling plane (:mod:`.obs.profiling`):
   live-capture a window from the operator's ``/debug/profile``
   endpoint, render a saved dump (span self-time table + top frames,
@@ -836,6 +847,108 @@ def cmd_events(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_chaos(args: argparse.Namespace) -> int:
+    """The chaos campaign engine (upgrade/chaos.py): run a declarative
+    fault-scenario sweep and print the resilience scorecard.  Exit 0
+    when every cell passes the rollout-invariant checker, 1 when any
+    cell fails, 2 on usage errors.  ``--selftest`` runs one real
+    brownout cell end-to-end and then proves the checker flags a
+    deliberately broken invariant (the ``make verify-chaos`` gate)."""
+    import logging as logging_mod
+
+    from .upgrade import chaos as chaos_mod
+
+    if not args.verbose:
+        # absorbed-fault warnings are the scenarios doing their job;
+        # they would drown the scorecard/selftest output
+        logging_mod.getLogger("k8s_operator_libs_tpu").setLevel(
+            logging_mod.ERROR
+        )
+    if args.fleet is not None and args.fleet < 1:
+        # same guard campaign_from_dict applies to the file's "fleet":
+        # an empty fleet would burn max_cycles per cell and report a
+        # misleading resilience failure instead of a usage error
+        print(f"--fleet must be >= 1, got {args.fleet}", file=sys.stderr)
+        return 2
+    if args.selftest:
+        try:
+            print(chaos_mod.selftest())
+        except AssertionError as err:
+            print(f"chaos selftest FAILED: {err}", file=sys.stderr)
+            return 1
+        return 0
+    if args.list:
+        for name in sorted(chaos_mod.SCENARIOS):
+            s = chaos_mod.SCENARIOS[name]
+            axes = (
+                f"transport={'|'.join(s.transports)} "
+                f"gates={'|'.join(s.gates)}"
+            )
+            print(f"{name:<26} [{axes}]\n    {s.description}")
+        return 0
+    if args.campaign:
+        try:
+            with open(args.campaign, "r", encoding="utf-8") as fh:
+                campaign = chaos_mod.campaign_from_dict(json.load(fh))
+        except FileNotFoundError:
+            print(f"campaign file not found: {args.campaign}", file=sys.stderr)
+            return 2
+        except OSError as err:
+            print(
+                f"cannot read campaign file {args.campaign}: {err}",
+                file=sys.stderr,
+            )
+            return 2
+        except (json.JSONDecodeError, ValueError, TypeError) as err:
+            print(
+                f"campaign file {args.campaign} is invalid: {err}",
+                file=sys.stderr,
+            )
+            return 2
+        # explicit CLI flags override the file (like --scenario/
+        # --transport below) — reproducing a failed cell with a
+        # different seed must not silently run the file's seed
+        if args.seed is not None:
+            campaign.seed = args.seed
+        if args.fleet is not None:
+            campaign.fleet_size = args.fleet
+    else:
+        campaign = chaos_mod.Campaign(
+            seed=args.seed if args.seed is not None else 0,
+            fleet_size=args.fleet if args.fleet is not None else 8,
+        )
+    if args.scenario:
+        unknown = [
+            s for s in args.scenario if s not in chaos_mod.SCENARIOS
+        ]
+        if unknown:
+            print(
+                f"unknown scenario(s) {', '.join(unknown)} — see "
+                "`chaos --list`",
+                file=sys.stderr,
+            )
+            return 2
+        campaign.scenarios = tuple(args.scenario)
+    if args.transport:
+        campaign.transports = tuple(args.transport)
+    if not campaign.cells():
+        print(
+            "the campaign selects zero cells (scenario/transport axes "
+            "exclude each other)",
+            file=sys.stderr,
+        )
+        return 2
+    progress = None
+    if not args.json:
+        progress = lambda msg: print(msg, file=sys.stderr)  # noqa: E731
+    scorecard = chaos_mod.run_campaign(campaign, progress=progress)
+    if args.json:
+        print(json.dumps(scorecard))
+    else:
+        print(chaos_mod.render_scorecard(scorecard))
+    return 0 if scorecard["cells_failed"] == 0 else 1
+
+
 def _load_profile_dump(path: str):
     """A profile dump from disk: native/speedscope JSON or collapsed
     text, normalized to ``(snapshot_dict, collapsed_counts)``.  Raises
@@ -1396,6 +1509,66 @@ def main(argv=None) -> int:
         help="same end-to-end smoke as `explain --selftest`",
     )
     ev.set_defaults(func=cmd_events)
+
+    ch = sub.add_parser(
+        "chaos",
+        help="chaos campaign engine: declarative fault-scenario sweeps "
+        "(brownouts, partitions, 410 storms, failovers, GC races...) "
+        "crossed with config axes, every cell checked by the rollout-"
+        "invariant checker against the decision stream; exit 1 when any "
+        "cell fails; --selftest smokes the engine AND proves the "
+        "checker can fail",
+    )
+    ch.add_argument(
+        "--campaign",
+        default="",
+        help="campaign file (JSON: name/seed/fleet/scenarios/axes); "
+        "default: the full built-in campaign",
+    )
+    ch.add_argument(
+        "--scenario",
+        action="append",
+        default=[],
+        help="run only this scenario (repeatable; see --list)",
+    )
+    ch.add_argument(
+        "--transport",
+        action="append",
+        choices=("inmem", "http"),
+        default=[],
+        help="restrict the transport axis (repeatable)",
+    )
+    ch.add_argument(
+        "--seed",
+        type=int,
+        default=None,
+        help="campaign seed (per-cell seeds derive from it "
+        "deterministically; overrides a --campaign file's; default 0)",
+    )
+    ch.add_argument(
+        "--fleet",
+        type=int,
+        default=None,
+        help="nodes per cell fleet (overrides a --campaign file's; "
+        "default 8)",
+    )
+    ch.add_argument(
+        "--list", action="store_true", help="print the scenario catalog"
+    )
+    ch.add_argument(
+        "--verbose",
+        action="store_true",
+        help="keep the library's absorbed-fault warnings on stderr",
+    )
+    ch.add_argument("--json", action="store_true", help="machine output")
+    ch.add_argument(
+        "--selftest",
+        action="store_true",
+        help="run one real brownout cell end-to-end (converges, every "
+        "invariant green) then prove the checker flags a deliberately "
+        "broken invariant — the make verify-chaos gate",
+    )
+    ch.set_defaults(func=cmd_chaos)
 
     pf = sub.add_parser(
         "profile",

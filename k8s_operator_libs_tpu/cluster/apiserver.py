@@ -73,6 +73,12 @@ from .writepipeline import (
 
 logger = logging.getLogger(__name__)
 
+#: with_faults sentinel: distinguishes "leave this knob as it is" from an
+#: explicit reset, so fault kinds COMPOSE — a campaign cell can layer a
+#: latency brownout under a targeted partition hook with two chained
+#: calls instead of one call that knows every knob.
+_UNSET = object()
+
 _REASONS = {
     UnauthorizedError: "Unauthorized",
     NotFoundError: "NotFound",
@@ -132,6 +138,26 @@ class _Handler(BaseHTTPRequestHandler):
     #: BATCH_WRITE_PATH).  False = vanilla-apiserver parity: the path
     #: 404s and the client transparently degrades to per-op writes.
     serve_batch_writes: bool = True
+    #: Fault-injection knobs (set per-facade via with_chaos/with_faults
+    #: on the bound handler subclass; class defaults = everything off).
+    chaos_drop_ratio: float = 0.0
+    chaos_rng = None
+    request_hook = None
+    held_stream_max_frames: int = 0
+    #: >0: every request stalls this long (×0.5-1.5 jitter from
+    #: latency_rng when seeded) before processing — the apiserver
+    #: brownout that slows, rather than drops, the control plane.
+    request_latency_seconds: float = 0.0
+    latency_rng = None
+    #: Targeted partition: predicate(method, info, namespace, name,
+    #: query) -> bool; True resets the connection abruptly AFTER routing
+    #: (the client sees ConnectionError), so a test can cut one kind's
+    #: traffic — an informer partition — while the rest flows.
+    partition_hook = None
+    #: Write-body mutation: hook(method, path, body) -> body|None; runs
+    #: after JSON parse, before handling.  The clock-skew seam: rewrite
+    #: an Event's timestamps as a skewed operator clock would have.
+    body_hook = None
 
     def _check_auth(self) -> None:
         if self.accepted_tokens is None:
@@ -160,9 +186,25 @@ class _Handler(BaseHTTPRequestHandler):
         if not raw:
             return None
         try:
-            return json.loads(raw)
+            body = json.loads(raw)
         except json.JSONDecodeError as err:
             raise BadRequestError(f"invalid JSON body: {err}") from err
+        hook = self.body_hook
+        if hook is not None and isinstance(body, dict):
+            mutated = hook(
+                getattr(self, "_fault_method", ""),
+                urlparse(self.path).path,
+                body,
+            )
+            if mutated is not None:
+                self._count_fault("body_mutations")
+                body = mutated
+        return body
+
+    def _count_fault(self, key: str) -> None:
+        counters = getattr(self, "fault_counters", None)
+        if counters is not None:
+            counters[key] = counters.get(key, 0) + 1
 
     def _send_json(self, code: int, body: JsonObj) -> None:
         data = json.dumps(body).encode()
@@ -193,6 +235,7 @@ class _Handler(BaseHTTPRequestHandler):
         ratio = getattr(self, "chaos_drop_ratio", 0.0)
         rng = getattr(self, "chaos_rng", None)
         if ratio and rng is not None and rng.random() < ratio:
+            self._count_fault("chaos_drops")
             self.close_connection = True
             try:
                 import socket as _socket
@@ -201,8 +244,21 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
             return
+        self._fault_method = method
         try:
             self._drain_body()
+            # Latency brownout (with_faults): stall AFTER the body is
+            # consumed (the connection stays synchronized) and before
+            # any processing — every request pays it, like an apiserver
+            # drowning in etcd latency.
+            latency = self.request_latency_seconds
+            if latency > 0:
+                jitter_rng = self.latency_rng
+                jitter = (
+                    0.5 + jitter_rng.random() if jitter_rng is not None else 1.0
+                )
+                self._count_fault("delayed_requests")
+                time.sleep(latency * jitter)
             self._check_auth()
             # Batch write endpoint (writepipeline.BATCH_WRITE_PATH):
             # outside every kind route, so a vanilla apiserver 404s it
@@ -234,6 +290,24 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 return
             (info, namespace, name, subresource), query = self._route()
+            # Targeted partition (with_faults): routed requests the
+            # predicate selects die with an abrupt connection reset —
+            # the network partition between ONE consumer (an informer's
+            # kind, a drain worker's evictions) and the apiserver, while
+            # everything else flows.
+            partition = self.partition_hook
+            if partition is not None and partition(
+                method, info, namespace, name, query
+            ):
+                self._count_fault("partition_drops")
+                self.close_connection = True
+                try:
+                    import socket as _socket
+
+                    self.connection.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                return
             # Fault-injection seam (ApiServerFacade.with_faults): runs
             # AFTER routing/auth and BEFORE handling, so a test can
             # mutate the store between two pages of one paginated LIST
@@ -807,9 +881,20 @@ class ApiServerFacade:
             "rejected": 0,
             "served": 0,
         }
-        #: Shared fault-injection counters (with_faults observability):
-        #: ``held_flaps`` counts abrupt held-stream resets served.
-        self.fault_counters: Dict[str, int] = {"held_flaps": 0}
+        #: Shared fault-injection counters (with_faults/with_chaos
+        #: observability — a chaos scenario that cannot show the chaos
+        #: happened proves nothing): ``held_flaps`` counts abrupt
+        #: held-stream resets, ``chaos_drops`` random request drops,
+        #: ``partition_drops`` targeted partition resets,
+        #: ``delayed_requests`` latency-stalled requests and
+        #: ``body_mutations`` write bodies rewritten by the body hook.
+        self.fault_counters: Dict[str, int] = {
+            "held_flaps": 0,
+            "chaos_drops": 0,
+            "partition_drops": 0,
+            "delayed_requests": 0,
+            "body_mutations": 0,
+        }
         self._handler_cls = type(
             "BoundHandler",
             (_Handler,),
@@ -846,13 +931,21 @@ class ApiServerFacade:
     def with_chaos(self, drop_ratio: float, seed: int = 0) -> "ApiServerFacade":
         """Drop a fraction of requests with an abrupt connection close
         before they are processed (fault injection for the
-        client/operator retry paths).  Chainable; ratio 0 disables.
+        client/operator retry paths).  Chainable — composes with
+        :meth:`with_faults`, so a campaign cell can layer drop-ratio
+        chaos UNDER a targeted request/partition hook (the chaos draw
+        runs first; survivors then meet the deterministic faults).
+        Ratio 0 disables; drops count into ``fault_counters
+        ["chaos_drops"]``.
 
         The seed pins the statistical RATE, not the drop pattern: the
         RNG is shared across handler threads, so thread scheduling
         decides which request consumes which draw.  Chaos consumers must
         assert properties that hold for any drop pattern (convergence,
-        legal transitions), never a specific sequence."""
+        legal transitions), never a specific sequence.  The chaos
+        campaign engine (:mod:`..upgrade.chaos`) derives this seed
+        deterministically per cell from (campaign seed, scenario, axis
+        values) so a cell replays with the same statistical profile."""
         import random as _random
 
         self._handler_cls.chaos_drop_ratio = drop_ratio
@@ -861,22 +954,81 @@ class ApiServerFacade:
 
     def with_faults(
         self,
-        request_hook=None,
-        held_stream_max_frames: int = 0,
+        request_hook=_UNSET,
+        held_stream_max_frames=_UNSET,
+        request_latency_seconds=_UNSET,
+        latency_seed=_UNSET,
+        partition_hook=_UNSET,
+        body_hook=_UNSET,
     ) -> "ApiServerFacade":
         """Deterministic fault injection (beyond with_chaos's random
-        drops).  *request_hook(method, info, namespace, name, query)*
-        runs after routing/auth and before handling on every request —
-        mutate the store between two pages of a paginated LIST to
-        expire a continue token, or raise an ApiError to fail chosen
-        requests.  *held_stream_max_frames* > 0 abruptly resets every
-        held watch stream after that many event frames (counted in
-        :data:`fault_counters`) — the mid-hold network flap.
-        Chainable; call with defaults to disable."""
-        self._handler_cls.request_hook = (
-            staticmethod(request_hook) if request_hook is not None else None
-        )
-        self._handler_cls.held_stream_max_frames = held_stream_max_frames
+        drops).  Only the knobs explicitly passed change — omitted ones
+        keep their current setting, so fault kinds COMPOSE across
+        chained calls (``facade.with_chaos(0.05, seed).with_faults(
+        request_hook=h).with_faults(request_latency_seconds=0.002)``);
+        :meth:`clear_faults` resets everything at once.
+
+        * *request_hook(method, info, namespace, name, query)* — runs
+          after routing/auth and before handling on every request:
+          mutate the store between two pages of one paginated LIST to
+          expire a continue token, or raise an ApiError to fail chosen
+          requests.  None disables.
+        * *held_stream_max_frames* > 0 — abruptly resets every held
+          watch stream after that many event frames (counted in
+          :data:`fault_counters` as ``held_flaps``) — the mid-hold
+          network flap.  0 disables.
+        * *request_latency_seconds* > 0 — every request stalls this
+          long before processing (the slow brownout); with
+          *latency_seed* set, each stall jitters ×0.5–1.5 from a seeded
+          shared RNG (rate deterministic, per-request draw scheduling-
+          dependent — same seed contract as with_chaos).  0 disables.
+        * *partition_hook(method, info, namespace, name, query)* →
+          bool — True resets that connection abruptly after routing
+          (counted as ``partition_drops``): a targeted partition
+          between one traffic class and the apiserver.  None disables.
+        * *body_hook(method, path, body)* → body|None — rewrite write
+          bodies after JSON parse (counted as ``body_mutations`` when a
+          non-None replacement is returned): the clock-skew seam.  None
+          disables."""
+        cls = self._handler_cls
+        if request_hook is not _UNSET:
+            cls.request_hook = (
+                staticmethod(request_hook) if request_hook is not None else None
+            )
+        if held_stream_max_frames is not _UNSET:
+            cls.held_stream_max_frames = int(held_stream_max_frames)
+        if request_latency_seconds is not _UNSET:
+            cls.request_latency_seconds = float(request_latency_seconds)
+        if latency_seed is not _UNSET:
+            import random as _random
+
+            cls.latency_rng = (
+                _random.Random(latency_seed) if latency_seed is not None else None
+            )
+        if partition_hook is not _UNSET:
+            cls.partition_hook = (
+                staticmethod(partition_hook)
+                if partition_hook is not None
+                else None
+            )
+        if body_hook is not _UNSET:
+            cls.body_hook = (
+                staticmethod(body_hook) if body_hook is not None else None
+            )
+        return self
+
+    def clear_faults(self) -> "ApiServerFacade":
+        """Reset every with_faults/with_chaos knob to off (counters are
+        left standing — they are the evidence of what already fired)."""
+        cls = self._handler_cls
+        cls.request_hook = None
+        cls.held_stream_max_frames = 0
+        cls.request_latency_seconds = 0.0
+        cls.latency_rng = None
+        cls.partition_hook = None
+        cls.body_hook = None
+        cls.chaos_drop_ratio = 0.0
+        cls.chaos_rng = None
         return self
 
     @property

@@ -114,6 +114,43 @@ GATE_REASONS: Dict[str, Tuple[str, ...]] = {
     "analysis": (REASON_SLO_GATE,),
 }
 
+#: Event type → the reason codes that type legally carries, or None for
+#: a policy-defined vocabulary (SloBreached's reason is the declared SLO
+#: name).  This IS the legal-reason-path oracle the chaos campaign's
+#: rollout-invariant checker (:mod:`..upgrade.chaos`) validates the
+#: decision stream against — an emit site inventing a reason without
+#: registering it here fails the campaign, which is the point.
+EVENT_REASONS: Dict[str, Optional[frozenset]] = {
+    EVENT_NODE_ADMITTED: frozenset({REASON_FRESH, REASON_BYPASS}),
+    EVENT_NODE_DEFERRED: frozenset(
+        {
+            REASON_BUDGET,
+            REASON_WINDOW,
+            REASON_PACING,
+            REASON_CANARY,
+            REASON_QUARANTINE,
+            REASON_REMEDIATION,
+            REASON_SKIP,
+            REASON_SLICE_DOMAIN,
+            REASON_SLO_GATE,
+        }
+    ),
+    EVENT_NODE_UNADMITTED: frozenset({REASON_ROLLBACK_OVERTOOK}),
+    EVENT_WAVE_PLANNED: frozenset({"scheduled"}),
+    EVENT_NODE_DRAINED: frozenset({"ok"}),
+    EVENT_NODE_DRAIN_FAILED: frozenset({"drain-error"}),
+    EVENT_NODE_UPGRADE_FAILED: frozenset({"attempt-failed"}),
+    EVENT_NODE_RETRIED: frozenset({"resync", "pod-replace"}),
+    EVENT_NODE_QUARANTINED: frozenset({"retry-budget"}),
+    EVENT_QUARANTINE_RELEASED: frozenset({"repaired"}),
+    EVENT_BREAKER_TRIPPED: frozenset({"failure-budget", "slo"}),
+    EVENT_ROLLBACK_STARTED: frozenset({"breaker"}),
+    EVENT_SLO_BREACHED: None,  # reason = the declared SLO's name
+    EVENT_ANALYSIS_STEP_ADVANCED: frozenset({REASON_SLO_GATE}),
+    EVENT_ANALYSIS_ABORTED: frozenset({REASON_SLO_GATE}),
+    EVENT_PACING_ADAPTED: frozenset({REASON_PACING_ADAPT}),
+}
+
 #: Default bound on retained (deduplicated) decision entries.
 DEFAULT_CAPACITY = 4096
 
@@ -367,6 +404,15 @@ class DecisionEventLog:
                 key=lambda e: e.seq,
             )
             return [e.to_dict() for e in changed], head
+
+    def export_stream(self) -> List[dict]:
+        """The checker's feed: every retained entry ordered by FIRST
+        occurrence (``firstSeq``) — the order decisions were first made,
+        which is what per-node reason-path legality is judged against
+        (``events()`` orders by last occurrence, the operator view)."""
+        with self._lock:
+            entries = sorted(self._entries.values(), key=lambda e: e.first_seq)
+            return [e.to_dict() for e in entries]
 
     def __len__(self) -> int:
         with self._lock:
@@ -687,13 +733,17 @@ class ClusterDecisionEventSink:
         if err is None:
             return 1
         if isinstance(err, AlreadyExistsError):
-            return self._adopt(name, entry)
+            return self._adopt(name, entry, failed)
         if isinstance(err, NotFoundError) and verb == "patch":
+            # The patch target is gone: the store's Event-TTL sweep
+            # collected it between pumps.  Recreate — the body carries
+            # the seq/src annotations, so the audit trail keeps its
+            # ordering oracle across the GC.
             try:
                 self._cluster.create(body)
                 return 1
             except AlreadyExistsError:
-                return self._adopt(name, entry)
+                return self._adopt(name, entry, failed)
             except (ApiError, OSError):
                 logger.warning("decision event recreate failed for %s", name)
                 self._written.pop(name, None)
@@ -704,7 +754,7 @@ class ClusterDecisionEventSink:
         failed.append(name)
         return 0
 
-    def _adopt(self, name: str, entry: dict) -> int:
+    def _adopt(self, name: str, entry: dict, failed: List[str]) -> int:
         """A create raced an Event that already exists under our
         deterministic name.  Two cases, told apart by the persisted
         sequence annotation:
@@ -717,14 +767,41 @@ class ClusterDecisionEventSink:
         * the existing Event is OUR OWN instance's at/after this
           entry's seq — an uncertain write (batch connection died after
           the server applied): adopt the count WITHOUT re-adding ours,
-          which would double-count."""
+          which would double-count.
+
+        The store's Event-TTL sweep can RACE this whole path (the
+        Event-GC race): the Event that made our create conflict may be
+        gone by the time we read or patch it.  Both windows degrade to
+        a plain recreate — with the seq annotation intact and without
+        inheriting the swept count — never to a dropped entry; any
+        other failure parks the entry in *failed* for the next pump's
+        retry (an edge-triggered decision must not be lost to a
+        transient)."""
         entry_seq = int(entry.get("seq") or 0)
         entry_count = int(entry.get("count") or 1)
         try:
             existing = self._cluster.get("Event", name, self._namespace)
+        except NotFoundError:
+            # TTL sweep collected it between our failed create and this
+            # read: recreate fresh (base dropped with the swept history).
+            self._base.pop(name, None)
+            try:
+                self._cluster.create(self._event_body(entry, name))
+                self._written[name] = entry_count
+                return 1
+            except (ApiError, OSError) as err:
+                logger.warning(
+                    "decision event adopt-recreate failed for %s: %s",
+                    name,
+                    err,
+                )
+                self._written.pop(name, None)
+                failed.append(name)
+                return 0
         except (ApiError, OSError) as err:
             logger.warning("decision event adopt failed for %s: %s", name, err)
             self._written.pop(name, None)
+            failed.append(name)
             return 0
         annotations = (existing.get("metadata") or {}).get("annotations") or {}
         try:
@@ -760,10 +837,30 @@ class ClusterDecisionEventSink:
                 },
                 self._namespace,
             )
+        except NotFoundError:
+            # swept between the read and the merge patch: the adopted
+            # history is gone — recreate with OUR occurrences only (a
+            # merged count would resurrect the swept history as a
+            # double count on the fresh object).
+            self._base.pop(name, None)
+            try:
+                self._cluster.create(self._event_body(entry, name))
+                self._written[name] = entry_count
+                return 1
+            except (ApiError, OSError) as err:
+                logger.warning(
+                    "decision event adopt-recreate failed for %s: %s",
+                    name,
+                    err,
+                )
+                self._written.pop(name, None)
+                failed.append(name)
+                return 0
         except (ApiError, OSError) as err:
             logger.warning("decision event adopt failed for %s: %s", name, err)
             self._written.pop(name, None)
             self._base.pop(name, None)
+            failed.append(name)
             return 0
         self._written[name] = merged
         return 1

@@ -55,9 +55,14 @@ from .upgrade_state import ClusterUpgradeStateManager, UpgradeStateError
 from .util import ClusterEventRecorder, EventRecorder, log_event
 from .validation_manager import ValidationManager
 
+# after upgrade_state: the chaos campaign engine drives the manager, so
+# it must import last to stay cycle-free
+from . import chaos  # noqa: E402
+
 __all__ = [
     "consts",
     "util",
+    "chaos",
     "ClusterUpgradeState",
     "CommonUpgradeManager",
     "NodeUpgradeState",

@@ -67,6 +67,22 @@ def stubbed_probes(monkeypatch):
         "bench_profile_overhead",
         lambda *a, **k: {"profile_overhead_pct_1024n": 99999.99},
     )
+    monkeypatch.setattr(
+        bench,
+        "chaos_section",
+        lambda *a, **k: {
+            "chaos_cells_passed": 9999,
+            "chaos_cells_total": 9999,
+            "chaos_scenarios": 9999,
+            "chaos_violations": 9999,
+            "chaos_wall_s": 99999.99,
+            "chaos_failed_cells": ["x" * 40] * 4,
+            "chaos_cells": [
+                {"scenario": "y" * 24, "passed": False, "wall_s": 99999.99}
+            ]
+            * 29,
+        },
+    )
     frame32 = "x" * 32
     monkeypatch.setattr(
         bench,
@@ -198,6 +214,12 @@ TRACKED_DETAIL_KEYS = (
     "scale_65536_nodes_per_min",
     "scale_retention_65536_vs_8192",
     "census_memo_speedup_1024n",
+    # the resilience scorecard (ISSUE 13): cells passed/total across
+    # the default chaos campaign's scenario × axis matrix — a
+    # resilience regression must be as visible per round as a speed one
+    "chaos_cells_passed",
+    "chaos_cells_total",
+    "chaos_scenarios",
 )
 
 

@@ -371,6 +371,132 @@ class TestClusterSink:
         sink2.pump(fresh)  # patch must write base(5) + local(2) = 7
         assert cluster.list("Event", namespace="default")[0]["count"] == 7
 
+    def test_gc_sweep_racing_adopt_read_recreates_with_seq(self, cluster):
+        """ISSUE 13 satellite (the Event-GC race): a restart adoption
+        whose create conflicted can find the conflicting Event GONE by
+        the time it reads it — the in-mem store's TTL sweep won the
+        race.  The sink must degrade to a plain recreate that KEEPS the
+        seq annotation (the offline ordering oracle) and counts only
+        its own occurrences — never drop the entry, never double-count
+        the swept history."""
+        from k8s_operator_libs_tpu.cluster.errors import NotFoundError
+
+        old = events_mod.DecisionEventLog()
+        for _ in range(5):
+            old.emit("NodeDeferred", "budget", "n2", now=1000.0)
+        events_mod.ClusterDecisionEventSink(cluster).pump(old)
+        name = cluster.list("Event", namespace="default")[0]["metadata"][
+            "name"
+        ]
+
+        fresh = events_mod.DecisionEventLog()  # restarted process
+        fresh.emit("NodeDeferred", "budget", "n2", now=2000.0)
+        sink2 = events_mod.ClusterDecisionEventSink(cluster)
+        real_get = cluster.get
+
+        def sweep_wins_get(kind, *args, **kwargs):
+            if kind == "Event":
+                # the TTL sweep collects the object between the failed
+                # create and the adopt's read
+                try:
+                    cluster.delete("Event", name, "default")
+                except NotFoundError:
+                    pass
+            return real_get(kind, *args, **kwargs)
+
+        cluster.get = sweep_wins_get
+        try:
+            assert sink2.pump(fresh) == 1
+        finally:
+            cluster.get = real_get
+        events = cluster.list("Event", namespace="default")
+        assert len(events) == 1
+        ev = events[0]
+        # our occurrences only — the swept history must not resurrect
+        assert ev["count"] == 1
+        annotations = ev["metadata"]["annotations"]
+        assert annotations.get(events_mod.SEQ_ANNOTATION) == "1"
+        assert annotations.get(events_mod.SRC_ANNOTATION) == fresh.instance
+        # and later patches build on the recreated object, not a ghost
+        fresh.emit("NodeDeferred", "budget", "n2", now=2001.0)
+        assert sink2.pump(fresh) == 1
+        assert cluster.list("Event", namespace="default")[0]["count"] == 2
+
+    def test_gc_sweep_racing_adopt_patch_does_not_double_count(
+        self, cluster
+    ):
+        """The sweep can also win between the adopt's READ and its
+        merge patch: the patch 404s.  Recreating with the merged count
+        would resurrect the swept history as a double count — the sink
+        must recreate with its own occurrences only."""
+        from k8s_operator_libs_tpu.cluster.errors import NotFoundError
+
+        old = events_mod.DecisionEventLog()
+        for _ in range(5):
+            old.emit("NodeDeferred", "budget", "n3", now=1000.0)
+        events_mod.ClusterDecisionEventSink(cluster).pump(old)
+        name = cluster.list("Event", namespace="default")[0]["metadata"][
+            "name"
+        ]
+
+        fresh = events_mod.DecisionEventLog()
+        fresh.emit("NodeDeferred", "budget", "n3", now=2000.0)
+        sink2 = events_mod.ClusterDecisionEventSink(cluster)
+        real_patch = cluster.patch
+
+        def sweep_wins_patch(kind, *args, **kwargs):
+            if kind == "Event":
+                try:
+                    cluster.delete("Event", name, "default")
+                except NotFoundError:
+                    pass
+            return real_patch(kind, *args, **kwargs)
+
+        cluster.patch = sweep_wins_patch
+        try:
+            assert sink2.pump(fresh) == 1
+        finally:
+            cluster.patch = real_patch
+        events = cluster.list("Event", namespace="default")
+        assert len(events) == 1
+        assert events[0]["count"] == 1, (
+            "the swept history double-counted through the recreate"
+        )
+        assert events[0]["metadata"]["annotations"].get(
+            events_mod.SEQ_ANNOTATION
+        )
+
+    def test_transient_adopt_failure_parks_entry_for_retry(self, cluster):
+        """An adoption that fails TRANSIENTLY (the read 500s) must park
+        the entry for the next pump like any other failed write — the
+        previous behavior dropped it, and an edge-triggered decision
+        (deduped into an existing Event name) would be lost for good."""
+        from k8s_operator_libs_tpu.cluster.errors import ApiError
+
+        old = events_mod.DecisionEventLog()
+        old.emit("BreakerTripped", "failure-budget", "fleet", now=1000.0)
+        events_mod.ClusterDecisionEventSink(cluster).pump(old)
+
+        fresh = events_mod.DecisionEventLog()  # restarted process
+        fresh.emit("BreakerTripped", "failure-budget", "fleet", now=2000.0)
+        sink2 = events_mod.ClusterDecisionEventSink(cluster)
+        real_get = cluster.get
+
+        def down_get(kind, *args, **kwargs):
+            if kind == "Event":
+                raise ApiError("etcd leader election")
+            return real_get(kind, *args, **kwargs)
+
+        cluster.get = down_get
+        try:
+            assert sink2.pump(fresh) == 0
+        finally:
+            cluster.get = real_get
+        # NOTHING new emitted — the parked retry alone must land the
+        # adoption (old 1 + ours 1)
+        assert sink2.pump(fresh) == 1
+        assert cluster.list("Event", namespace="default")[0]["count"] == 2
+
     def test_offline_order_survives_operator_restart(self, cluster):
         """The per-process sequence restarts at 0; the reconstruction
         orders by timestamp FIRST so a restarted operator's fresh

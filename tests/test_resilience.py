@@ -354,110 +354,16 @@ class TestSplitBrain:
 
 # ---------------------------------------------------------------------------
 # Transition legality: every observed state-label change rides a legal edge
-# of the reference's lifecycle graph (SURVEY.md §2 state diagram).
+# of the reference's lifecycle graph (SURVEY.md §2 state diagram).  The edge
+# set and the journal reader are the CANONICAL ones from upgrade/chaos.py —
+# the chaos campaign's rollout-invariant checker and this property suite
+# must judge the same graph, so there is exactly one definition.
 # ---------------------------------------------------------------------------
 
-_C = consts
-#: The legal edge set.  Sources: ApplyState's per-state processors
-#: (upgrade_state.go:204-278) plus this library's post-maintenance gate and
-#: the requestor's missing-CR fallback (upgrade_requestor.go:420-432).
-LEGAL_TRANSITIONS = frozenset(
-    {
-        (_C.UPGRADE_STATE_UNKNOWN, _C.UPGRADE_STATE_DONE),
-        (_C.UPGRADE_STATE_UNKNOWN, _C.UPGRADE_STATE_UPGRADE_REQUIRED),
-        (_C.UPGRADE_STATE_DONE, _C.UPGRADE_STATE_UPGRADE_REQUIRED),
-        (_C.UPGRADE_STATE_UPGRADE_REQUIRED, _C.UPGRADE_STATE_CORDON_REQUIRED),
-        (
-            _C.UPGRADE_STATE_UPGRADE_REQUIRED,
-            _C.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED,
-        ),
-        (
-            _C.UPGRADE_STATE_CORDON_REQUIRED,
-            _C.UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED,
-        ),
-        (
-            _C.UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED,
-            _C.UPGRADE_STATE_POD_DELETION_REQUIRED,
-        ),
-        (
-            _C.UPGRADE_STATE_WAIT_FOR_JOBS_REQUIRED,
-            _C.UPGRADE_STATE_DRAIN_REQUIRED,
-        ),
-        (
-            _C.UPGRADE_STATE_POD_DELETION_REQUIRED,
-            _C.UPGRADE_STATE_POD_RESTART_REQUIRED,
-        ),
-        (
-            _C.UPGRADE_STATE_POD_DELETION_REQUIRED,
-            _C.UPGRADE_STATE_DRAIN_REQUIRED,
-        ),
-        (_C.UPGRADE_STATE_POD_DELETION_REQUIRED, _C.UPGRADE_STATE_FAILED),
-        (
-            _C.UPGRADE_STATE_DRAIN_REQUIRED,
-            _C.UPGRADE_STATE_POD_RESTART_REQUIRED,
-        ),
-        (_C.UPGRADE_STATE_DRAIN_REQUIRED, _C.UPGRADE_STATE_FAILED),
-        (
-            _C.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED,
-            _C.UPGRADE_STATE_POD_RESTART_REQUIRED,
-        ),
-        (
-            _C.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED,
-            _C.UPGRADE_STATE_POST_MAINTENANCE_REQUIRED,
-        ),
-        (
-            _C.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED,
-            _C.UPGRADE_STATE_UPGRADE_REQUIRED,
-        ),
-        (
-            _C.UPGRADE_STATE_POST_MAINTENANCE_REQUIRED,
-            _C.UPGRADE_STATE_POD_RESTART_REQUIRED,
-        ),
-        (
-            _C.UPGRADE_STATE_POD_RESTART_REQUIRED,
-            _C.UPGRADE_STATE_VALIDATION_REQUIRED,
-        ),
-        (
-            _C.UPGRADE_STATE_POD_RESTART_REQUIRED,
-            _C.UPGRADE_STATE_UNCORDON_REQUIRED,
-        ),
-        (_C.UPGRADE_STATE_POD_RESTART_REQUIRED, _C.UPGRADE_STATE_DONE),
-        (_C.UPGRADE_STATE_POD_RESTART_REQUIRED, _C.UPGRADE_STATE_FAILED),
-        (
-            _C.UPGRADE_STATE_VALIDATION_REQUIRED,
-            _C.UPGRADE_STATE_UNCORDON_REQUIRED,
-        ),
-        (_C.UPGRADE_STATE_VALIDATION_REQUIRED, _C.UPGRADE_STATE_DONE),
-        (_C.UPGRADE_STATE_VALIDATION_REQUIRED, _C.UPGRADE_STATE_FAILED),
-        (_C.UPGRADE_STATE_FAILED, _C.UPGRADE_STATE_UNCORDON_REQUIRED),
-        (_C.UPGRADE_STATE_FAILED, _C.UPGRADE_STATE_DONE),
-        # remediation retry budget (upgrade/remediation.py): a failed
-        # node whose pod is out of sync with the target (new revision or
-        # LKG rollback waiting) re-enters the wave after its backoff
-        (_C.UPGRADE_STATE_FAILED, _C.UPGRADE_STATE_UPGRADE_REQUIRED),
-        # remediation rollback overtaking admission: a pending node whose
-        # pod is back in sync after the LKG revert returns to done
-        # without riding the wave (no cordon/drain for a no-op)
-        (_C.UPGRADE_STATE_UPGRADE_REQUIRED, _C.UPGRADE_STATE_DONE),
-        (_C.UPGRADE_STATE_UNCORDON_REQUIRED, _C.UPGRADE_STATE_DONE),
-    }
+from k8s_operator_libs_tpu.upgrade.chaos import (  # noqa: E402
+    LEGAL_TRANSITIONS,
+    observed_transitions,
 )
-
-
-def observed_transitions(cluster, since_seq: int = 0):
-    """Every node state-label change in the watch journal after *since_seq*."""
-    key = util.get_upgrade_state_label_key()
-    moves = []
-    for ev in cluster.events_since(since_seq, kind="Node"):
-        if ev.new is None:
-            continue
-        old_state = (((ev.old or {}).get("metadata") or {}).get("labels") or {}).get(
-            key, ""
-        )
-        new_state = ((ev.new.get("metadata") or {}).get("labels") or {}).get(key, "")
-        if old_state != new_state:
-            moves.append((old_state, new_state))
-    return moves
 
 
 class TestTransitionLegality:
@@ -1467,4 +1373,103 @@ class TestPaginatedPathChaos:
         )
         assert after - before >= 1, (
             "no watch re-establishment recorded after flaps"
+        )
+
+
+class TestJournalStormUnderPaginatedRelist:
+    """ISSUE 13 satellite: journal-retention 410 storms under paginated
+    relist.  The state index's auto full-rebuild path (410 on its
+    events_since cursor → rebuild("journal-expired") through the
+    server-paginated LIST) must absorb REPEATED storms mid-wave — the
+    previous coverage was a single expire_snapshots_hook case on the
+    pager alone, with no state index in the loop."""
+
+    def test_repeated_storms_rebuild_index_mid_wave_and_converge(self):
+        from k8s_operator_libs_tpu import metrics
+        from k8s_operator_libs_tpu.cluster import (
+            ApiServerFacade,
+            KubeApiClient,
+            KubeConfig,
+        )
+
+        rebuilds = metrics.default_registry().counter(
+            "state_index_rebuilds_total",
+            "Full ClusterStateIndex resyncs, by reason "
+            "(seed | journal-expired | relist).",
+            ("reason",),
+        )
+        before = rebuilds.value("journal-expired")
+
+        store = InMemoryCluster()
+        store._journal_cap = 60  # tight retention: churn compacts fast
+        state = {"writes": 0, "storms": 0}
+
+        def roll_journal() -> None:
+            # push the retention floor past every open journal cursor
+            # (the index's, the fleet informer's) in one burst
+            for _ in range(80):
+                state["writes"] += 1
+                store.create(
+                    {
+                        "kind": "Event",
+                        "metadata": {
+                            "name": f"storm-{state['writes']}",
+                            "namespace": NAMESPACE,
+                        },
+                        "reason": "ChaosChurn",
+                    }
+                )
+            state["storms"] += 1
+
+        facade = ApiServerFacade(store, max_list_page=3).start()
+        manager = None
+        try:
+            client = KubeApiClient(KubeConfig(server=facade.url), timeout=10.0)
+            fleet = Fleet(client)
+            for i in range(8):
+                fleet.add_node(f"n{i}", pod_hash="rev1")
+            fleet.publish_new_revision("rev2")
+            manager = ClusterUpgradeStateManager(
+                client,
+                use_state_index=True,
+                cache_sync_timeout_seconds=2.0,
+                cache_sync_poll_seconds=0.01,
+            )
+            # one node at a time stretches the wave so multiple storms
+            # land strictly MID-rollout, not after convergence
+            policy = UpgradePolicySpec(
+                auto_upgrade=True,
+                max_parallel_upgrades=1,
+                max_unavailable=IntOrString(1),
+                drain_spec=DrainSpec(
+                    enable=True, force=True, timeout_second=10
+                ),
+            )
+            converged = False
+            for cycle in range(80):
+                if cycle and cycle % 2 == 0:
+                    roll_journal()
+                s = manager.build_state(NAMESPACE, DRIVER_LABELS)
+                manager.apply_state(s, policy)
+                manager.drain_manager.wait_idle(10)
+                manager.pod_manager.wait_idle(10)
+                fleet.reconcile_daemonset()
+                if set(fleet.states().values()) == {
+                    consts.UPGRADE_STATE_DONE
+                }:
+                    converged = True
+                    break
+            assert converged, f"storms wedged the rollout: {fleet.states()}"
+        finally:
+            if manager is not None:
+                manager.shutdown()
+            facade.stop()
+        # the chaos demonstrably happened — repeatedly — and the index
+        # took its journal-expired full rebuild each time instead of
+        # silently serving stale assemblies or falling over
+        assert state["storms"] >= 3, "journal never stormed mid-wave"
+        assert rebuilds.value("journal-expired") - before >= 3, (
+            "the state index's auto full-rebuild path was not exercised "
+            "repeatedly (journal-expired rebuilds "
+            f"{rebuilds.value('journal-expired') - before})"
         )
