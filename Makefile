@@ -13,7 +13,7 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 	cov-report clean help image .build-image kind-e2e kind-e2e-stub \
 	tpu-smoke tpu-probe tpu-watch tpu-stage verify verify-obs \
 	verify-remediation verify-slo verify-events verify-profile \
-	verify-pacing verify-chaos chaos
+	verify-pacing verify-chaos verify-race chaos
 
 # Enforced coverage floor (VERDICT r4 next #6).  Full-suite line
 # coverage measured by the zero-dependency sys.monitoring tracer
@@ -22,7 +22,7 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 # the floor as coverage rises, never lower it to make a failure pass.
 COV_FLOOR ?= 91
 
-all: lint test
+all: lint test verify-race
 
 help:
 	@grep -E '^[a-z-]+:' Makefile | sed 's/:.*//' | sort -u
@@ -95,21 +95,40 @@ verify-chaos:
 	$(PYTHON) -m pytest tests/test_chaos.py -q
 	$(PYTHON) -m k8s_operator_libs_tpu chaos --selftest
 
-# The full default campaign (12 fault scenarios × transport/gates axes,
-# ~30 cells): the standing resilience scorecard, exit 1 on any failed
-# cell.  Slower than verify-chaos; run when touching fault paths.
+# The full default campaign (12 fault scenarios × transport/gates/
+# driver axes, ~40 cells): the standing resilience scorecard, exit 1
+# on any failed cell.  Slower than verify-chaos; run when touching
+# fault paths.
 chaos:
 	$(PYTHON) -m k8s_operator_libs_tpu chaos
+
+# Concurrency gate (the two-part sanitizer, docs/concurrency.md):
+# 1. the static lock-discipline pass must be finding-free on the whole
+#    package (waivers <= 10, each with a reason — hack/lockcheck.py);
+# 2. the analyzer + runtime-watcher suites must catch their seeded
+#    fixture races/deadlocks BY NAME (mixed-guard, lock-order-cycle,
+#    wait-not-in-loop, blocking-under-lock, notify-unheld);
+# 3. the racewatch-instrumented fast suite (RACEWATCH=1 wraps every
+#    Lock/RLock/Condition the suite creates) must close with ZERO
+#    lock-order cycles — conftest's sessionfinish fails the run on any,
+#    printing both witness stacks and the longest-held locks.
+verify-race:
+	$(PYTHON) hack/lockcheck.py
+	$(PYTHON) -m pytest tests/test_lockcheck.py tests/test_racewatch.py -q
+	RACEWATCH=1 $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+		--ignore=tests/test_tpu_integration.py \
+		--continue-on-collection-errors
 
 # The whole verify chain — every subsystem gate in one target (CI runs
 # this; each sub-gate stays runnable alone for the inner loop).
 verify: verify-obs verify-remediation verify-slo verify-events \
-	verify-profile verify-pacing verify-chaos
+	verify-profile verify-pacing verify-chaos verify-race
 
 lint:
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu examples bench.py __graft_entry__.py
 	$(PYTHON) hack/lint.py
 	$(PYTHON) hack/typecheck.py k8s_operator_libs_tpu examples bench.py __graft_entry__.py hack
+	$(PYTHON) hack/lockcheck.py
 
 bench:
 	$(PYTHON) bench.py

@@ -1572,6 +1572,61 @@ def chaos_section(seed: int = 0, fleet: int = 8) -> dict:
     return out
 
 
+def race_section(seed: int = 0) -> dict:
+    """Concurrency-sanitizer status in the tail (ISSUE 14): the static
+    lock-discipline sweep must be finding-free (``lockcheck_findings``
+    0, waivers within budget), and one racewatch-instrumented chaos
+    cell (policy-edits, event driver — drain workers, the write path
+    and the wakeup queue all fire) must close with zero lock-order
+    cycles, with the longest-held lock sites named beside the sampled
+    frames.  ``BENCH_SKIP_RACE=1`` skips."""
+    if os.environ.get("BENCH_SKIP_RACE"):
+        return {"lockcheck_findings": -1, "race_skipped": True}
+    import logging as logging_mod
+    import sys as sys_mod
+
+    hack_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "hack")
+    if hack_dir not in sys_mod.path:
+        sys_mod.path.insert(0, hack_dir)
+    import lockcheck
+
+    findings, waivers, _classes = lockcheck.check_paths(
+        ["k8s_operator_libs_tpu"]
+    )
+    from k8s_operator_libs_tpu.obs import racewatch
+    from k8s_operator_libs_tpu.upgrade import chaos as chaos_mod
+
+    chaos_logger = logging_mod.getLogger("k8s_operator_libs_tpu")
+    prev_level = chaos_logger.level
+    chaos_logger.setLevel(logging_mod.ERROR)
+    racewatch.install()
+    racewatch.reset()
+    try:
+        scenario = chaos_mod.SCENARIOS["policy-edits"]
+        cell_seed = chaos_mod.cell_seed(
+            seed, scenario.name, "inmem", "on", 6, "event"
+        )
+        chaos_mod.run_cell(
+            scenario, "inmem", "on", 6, cell_seed, driver="event"
+        )
+    finally:
+        racewatch.uninstall()
+        chaos_logger.setLevel(prev_level)
+    rep = racewatch.report()
+    out = {
+        "lockcheck_findings": len(findings),
+        "lockcheck_waivers": len(waivers),
+        "lock_order_cycles": rep["cycle_count"],
+        "lock_sites": rep["sites"],
+        # shed-listed: site -> cumulative hold ms for the top holders
+        "top_lock_hold_ms": {
+            row["site"]: row["hold_ms"] for row in rep["locks"][:3]
+        },
+    }
+    racewatch.reset()
+    return out
+
+
 def bench_policies() -> tuple:
     """(reference-defaults policy, tuned slice-aware policy) — ONE
     definition shared by the headline bench and ``--profile`` so the
@@ -1655,8 +1710,16 @@ def main() -> None:
     remediation = remediation_section()
 
     # ---- resilience scorecard: the default chaos campaign (12 fault
-    # scenarios × transport/gates axes, invariant-checked per cell)
+    # scenarios × transport/gates/driver axes, invariant-checked per
+    # cell)
     chaos = chaos_section()
+
+    # ---- concurrency sanitizer: static lockcheck sweep + one
+    # racewatch-instrumented event-driver cell (zero findings / zero
+    # lock-order cycles is the contract; top holders ride shed-listed).
+    # AFTER chaos_section so the instrumentation never wraps locks the
+    # perf probes above will keep exercising.
+    race = race_section()
 
     # ---- event-driven reconcile acceptance: idle-fleet passes/min
     # (polling vs event-driven, profile-diffed), node-flip reaction at
@@ -1733,6 +1796,7 @@ def main() -> None:
                     **scale,
                     **remediation,
                     **{k: v for k, v in chaos.items() if k != "chaos_cells"},
+                    **race,
                     **event_driven,
                     **census,
                     "engine": {
@@ -1829,6 +1893,9 @@ COMPACT_LINE_BUDGET = 1900
 #: but with this list sized right it never reaches the tracked keys OR
 #: the tpu/compute_cpu evidence sections at the back.
 COMPACT_SHED_FIRST = (
+    "top_lock_hold_ms",
+    "lock_sites",
+    "lockcheck_waivers",
     "profile_pair_walls_s",
     "profile_inmem_top",
     "profile_idle_poll_top",

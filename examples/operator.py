@@ -320,6 +320,15 @@ def main() -> int:
         help="stop after N seconds (0 = run until interrupted)",
     )
     args = parser.parse_args()
+    # RACEWATCH=1: instrument every lock this process creates (the
+    # opt-in concurrency sanitizer, docs/concurrency.md) — installed
+    # FIRST so the manager/controller locks are born watched, and
+    # /debug/profile?locks=1 serves the live hold/contention stats +
+    # lock-order graph
+    from k8s_operator_libs_tpu.obs import racewatch
+
+    if racewatch.enabled_by_env():
+        racewatch.install()
     # control-plane GC profile: the reconcile loop's copy-on-read
     # substrate allocates heavily; default CPython thresholds make GC
     # the dominant super-linear cost at fleet scale (runtime.py)

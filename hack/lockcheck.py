@@ -1,0 +1,1056 @@
+#!/usr/bin/env python
+"""Static lock-discipline checker — the concurrency third of `make lint`.
+
+The Go reference gets `go test -race` for free; this repo has ~220
+lock-guarded attribute references across a dozen distinct locks and
+seven Condition objects, and every recent review pass hand-found a real
+concurrency bug (unordered gauge sets, stale span stacks, concurrent-
+capture double-starts).  This checker automates the discipline half of
+that review, per class:
+
+* **guarded-attribute inference** — which ``self._*`` attributes are
+  accessed inside ``with self._lock:`` / ``with self._cond:`` blocks,
+  including helper methods only ever called while the lock is held
+  (conservative fixpoint: a helper's callers must ALL hold the lock for
+  the helper's body to count as guarded);
+* **mixed discipline** — an attribute written after ``__init__`` that is
+  touched both under a guard and outside it from different methods is
+  flagged: either the unguarded touch is a race, or the guard is
+  superstition — both are findings;
+* **declared intent** — ``#: guarded-by: _lock`` on the attribute
+  assignment (or on a ``def``, declaring a caller-holds-the-lock
+  contract) turns inference into enforcement: EVERY unguarded access
+  flags, not just mixed ones;
+* **condition discipline** — ``Condition.wait()`` must sit in a
+  ``while``-predicate loop (missed/spurious wakeups otherwise);
+  ``notify``/``notify_all`` must run with the condition held;
+* **blocking under a lock** — ``time.sleep``, thread ``join``,
+  ``wait_for_*`` calls and socket/HTTP sends made while any lock is
+  held convoy every other user of that lock;
+* **lock-order cycles** — nested acquisitions build a per-class order
+  graph; a cycle (``A→B`` in one method, ``B→A`` in another) is a
+  potential deadlock, reported with both witness sites.
+
+Deliberate lock-free fast paths are waived in-code::
+
+    #: lockcheck: unguarded(benign snapshot read; torn reads acceptable)
+    return len(self._queue)
+
+Waivers require a reason, are counted, and are capped (default 10
+package-wide) — a tree that needs more waivers than that needs a
+refactor, not a bigger cap.  Stale waivers (suppressing nothing) fail
+too, so the inventory stays honest.
+
+Deliberately out of scope (the runtime watcher, obs/racewatch.py,
+covers these): cross-class lock ordering, manual ``acquire()``/
+``release()`` pairs, closures/lambdas executed on other threads, and
+module-level locks.  Zero findings on clean code is the contract —
+every check here fails CI, so false positives are worse than misses.
+
+Usage: python hack/lockcheck.py [--json] [--max-waivers N] [paths...]
+Exit 1 on any finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+DEFAULT_ROOTS = ["k8s_operator_libs_tpu"]
+
+#: package-wide waiver budget (ISSUE 14 acceptance: <= 10, each with a
+#: reason).  Raise only with a PR-description argument.
+MAX_WAIVERS = 10
+
+#: methods whose accesses never count toward discipline: construction
+#: happens-before publication (and __del__ runs post-quiescence).
+CONSTRUCTION_METHODS = {"__init__", "__post_init__", "__del__"}
+
+#: module-function calls that block the calling thread.
+BLOCKING_FUNCS = {("time", "sleep"), ("socket", "create_connection")}
+
+#: receiver-method names that block (sockets / HTTP / process waits).
+BLOCKING_METHODS = {
+    "sendall",
+    "recv",
+    "getresponse",
+    "urlopen",
+    "connect",
+    "communicate",
+}
+
+_GUARDED_BY_RE = re.compile(r"#:\s*guarded-by:\s*([A-Za-z_]\w*)")
+_WAIVER_RE = re.compile(r"#:\s*lockcheck:\s*unguarded\(([^)]*)\)")
+
+
+@dataclass
+class Finding:
+    path: str
+    lineno: int
+    category: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.lineno}: [{self.category}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.lineno,
+            "category": self.category,
+            "message": self.message,
+        }
+
+
+@dataclass
+class Waiver:
+    path: str
+    lineno: int  # the line the waiver comment sits on
+    target: int  # the code line it suppresses
+    reason: str
+    used: bool = False
+
+
+# --------------------------------------------------------------------------
+# Source-comment annotations (AST drops comments; read the text).
+# --------------------------------------------------------------------------
+def _string_spans(text: str) -> Dict[int, List[Tuple[int, int]]]:
+    """Per-line column spans occupied by string literals — source
+    QUOTING an annotation (a docstring example, a regex literal) must
+    not parse as one.  Multi-line strings occupy their middle lines
+    fully."""
+    out: Dict[int, List[Tuple[int, int]]] = {}
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return out
+    big = 1 << 30
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            end = getattr(node, "end_lineno", node.lineno)
+            if end == node.lineno:
+                out.setdefault(node.lineno, []).append(
+                    (node.col_offset, getattr(node, "end_col_offset", big))
+                )
+            else:
+                out.setdefault(node.lineno, []).append(
+                    (node.col_offset, big)
+                )
+                for line in range(node.lineno + 1, end):
+                    out.setdefault(line, []).append((0, big))
+                out.setdefault(end, []).append(
+                    (0, getattr(node, "end_col_offset", big))
+                )
+    return out
+
+
+def _in_string(
+    spans: Dict[int, List[Tuple[int, int]]], line: int, col: int
+) -> bool:
+    return any(lo <= col < hi for lo, hi in spans.get(line, ()))
+
+
+def parse_annotations(
+    text: str, path: str
+) -> Tuple[Dict[int, str], List[Waiver], List[Finding]]:
+    """(guarded_by_line -> lock name, waivers, syntax findings).
+
+    Both annotation forms attach to the line they trail, or — on a
+    comment-only line — to the next non-blank non-comment line."""
+    guards: Dict[int, str] = {}
+    waivers: List[Waiver] = []
+    findings: List[Finding] = []
+    lines = text.splitlines()
+    spans = _string_spans(text)
+
+    def _target_line(i: int) -> int:
+        stripped = lines[i - 1].split("#", 1)[0].strip()
+        if stripped:
+            return i  # trailing comment: attaches to its own line
+        for j in range(i + 1, len(lines) + 1):
+            nxt = lines[j - 1].strip()
+            if nxt and not nxt.startswith("#"):
+                return j
+        return i
+
+    for i, line in enumerate(lines, 1):
+        m = _GUARDED_BY_RE.search(line)
+        if m and not _in_string(spans, i, m.start()):
+            guards[_target_line(i)] = m.group(1)
+        m = _WAIVER_RE.search(line)
+        if m and not _in_string(spans, i, m.start()):
+            reason = m.group(1).strip()
+            if not reason:
+                findings.append(
+                    Finding(
+                        path,
+                        i,
+                        "waiver-syntax",
+                        "waiver has an empty reason — every unguarded() "
+                        "needs a justification string",
+                    )
+                )
+            waivers.append(Waiver(path, i, _target_line(i), reason))
+        else:
+            pos = line.find("lockcheck:")
+            hash_pos = line.find("#")
+            if (
+                m is None
+                and pos >= 0
+                and 0 <= hash_pos < pos
+                and not _in_string(spans, i, pos)
+                and not _in_string(spans, i, hash_pos)
+            ):
+                findings.append(
+                    Finding(
+                        path,
+                        i,
+                        "waiver-syntax",
+                        "malformed lockcheck annotation (want "
+                        "'#: lockcheck: unguarded(reason)')",
+                    )
+                )
+    return guards, waivers, findings
+
+
+# --------------------------------------------------------------------------
+# Per-class model.
+# --------------------------------------------------------------------------
+@dataclass
+class Access:
+    attr: str
+    held: frozenset  # lock groups held at the access site
+    method: str
+    lineno: int
+    is_store: bool
+    cls: str = ""
+    #: file the access lives in — findings/waivers anchor HERE, so a
+    #: base-class witness pooled into a subclass's analysis reports
+    #: (and waives) at its true site
+    path: str = ""
+
+
+@dataclass
+class CallSite:
+    callee: str
+    held: frozenset
+    method: str
+    lineno: int
+
+
+@dataclass
+class CondEvent:
+    kind: str  # "wait" | "wait-no-loop" | "notify"
+    group: str
+    held: frozenset
+    method: str
+    lineno: int
+
+
+@dataclass
+class BlockingCall:
+    desc: str
+    held: frozenset
+    method: str
+    lineno: int
+
+
+@dataclass
+class OrderEdge:
+    src: str
+    dst: str
+    method: str
+    lineno: int
+
+
+@dataclass
+class ClassModel:
+    name: str
+    module: str
+    path: str
+    bases: List[str] = field(default_factory=list)
+    #: lock attr -> kind ("Lock" | "RLock" | "Condition")
+    locks: Dict[str, str] = field(default_factory=dict)
+    #: lock attr -> group leader (Condition(self._lock) shares _lock's)
+    group_of: Dict[str, str] = field(default_factory=dict)
+    #: attrs assigned threading.Thread(...) — join() on these blocks
+    thread_attrs: Set[str] = field(default_factory=set)
+    #: declared guard per attribute (a guarded-by tag on the assign)
+    declared: Dict[str, str] = field(default_factory=dict)
+    #: declared caller-holds contract per method name
+    method_guard: Dict[str, str] = field(default_factory=dict)
+    #: source line each declaration was parsed from (annotation
+    #: validation — hack/typecheck.py consumes these)
+    declared_at: Dict[str, int] = field(default_factory=dict)
+    method_guard_at: Dict[str, int] = field(default_factory=dict)
+    accesses: List[Access] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+    cond_events: List[CondEvent] = field(default_factory=list)
+    blocking: List[BlockingCall] = field(default_factory=list)
+    order_edges: List[OrderEdge] = field(default_factory=list)
+    methods: Set[str] = field(default_factory=set)
+    #: attrs with a Store outside construction methods
+    mutated: Set[str] = field(default_factory=set)
+    #: the ClassDef node — method walking is deferred until inherited
+    #: locks have merged in, so `with self._lock:` resolves even when
+    #: the lock is assigned by a (possibly cross-module) base class
+    node: object = None
+
+    def group(self, lock_attr: str) -> str:
+        seen = set()
+        cur = lock_attr
+        while cur in self.group_of and cur not in seen:
+            seen.add(cur)
+            cur = self.group_of[cur]
+        return cur
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _lock_ctor(node: ast.AST) -> Optional[Tuple[str, Optional[str]]]:
+    """(kind, shared-lock-attr) when *node* constructs a threading
+    primitive: ``threading.Lock()``, ``RLock()``, ``Condition()`` or
+    ``Condition(self._lock)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    name = None
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        if fn.value.id == "threading":
+            name = fn.attr
+    elif isinstance(fn, ast.Name):
+        if fn.id in ("Lock", "RLock", "Condition"):
+            name = fn.id
+    if name not in ("Lock", "RLock", "Condition"):
+        return None
+    shared = None
+    if name == "Condition":
+        args = list(node.args) + [
+            kw.value for kw in node.keywords if kw.arg == "lock"
+        ]
+        if args:
+            shared = _self_attr(args[0])
+    return name, shared
+
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        return fn.value.id == "threading" and fn.attr == "Thread"
+    return isinstance(fn, ast.Name) and fn.id == "Thread"
+
+
+class _MethodWalker:
+    """Statement walker tracking the set of held lock groups through
+    ``with self._x:`` blocks.  Nested function/lambda bodies are skipped
+    (they run on other threads/later — the runtime watcher's job)."""
+
+    def __init__(self, model: ClassModel, method: str) -> None:
+        self.model = model
+        self.method = method
+        self.held: Tuple[str, ...] = ()
+        self.while_depth = 0
+
+    # ----------------------------------------------------------- helpers
+    def _record_access(self, attr: str, lineno: int, is_store: bool) -> None:
+        self.model.accesses.append(
+            Access(
+                attr,
+                frozenset(self.held),
+                self.method,
+                lineno,
+                is_store,
+                self.model.name,
+                self.model.path,
+            )
+        )
+        if is_store and self.method not in CONSTRUCTION_METHODS:
+            self.model.mutated.add(attr)
+
+    def _enter_lock(self, group: str, lineno: int) -> bool:
+        for holder in self.held:
+            if holder != group:
+                self.model.order_edges.append(
+                    OrderEdge(holder, group, self.method, lineno)
+                )
+        if group in self.held:
+            return False  # re-entrant with (RLock) — no new hold level
+        self.held = self.held + (group,)
+        return True
+
+    def _exit_lock(self) -> None:
+        self.held = self.held[:-1]
+
+    # ------------------------------------------------------------- walk
+    def walk(self, fn: ast.FunctionDef) -> None:
+        self.model.methods.add(fn.name)
+        for stmt in fn.body:
+            self._visit(stmt)
+
+    def _visit(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # other-thread / deferred execution: out of scope
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            self._visit_with(node)
+            return
+        if isinstance(node, ast.While):
+            self._visit_expr(node.test)
+            self.while_depth += 1
+            for stmt in node.body:
+                self._visit(stmt)
+            self.while_depth -= 1
+            for stmt in node.orelse:
+                self._visit(stmt)
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(node)
+            # fall through: visit children too (nested calls/args)
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None:
+                self._record_access(
+                    attr,
+                    node.lineno,
+                    isinstance(node.ctx, (ast.Store, ast.Del)),
+                )
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    _visit_expr = _visit
+
+    def _visit_with(self, node: ast.With) -> None:
+        entered: List[bool] = []
+        for item in node.items:
+            ctx = item.context_expr
+            attr = _self_attr(ctx)
+            if attr is not None and attr in self.model.locks:
+                self._record_access(attr, ctx.lineno, False)
+                entered.append(
+                    self._enter_lock(self.model.group(attr), ctx.lineno)
+                )
+            else:
+                self._visit(ctx)
+            if item.optional_vars is not None:
+                self._visit(item.optional_vars)
+        for stmt in node.body:
+            self._visit(stmt)
+        for did_enter in reversed(entered):
+            if did_enter:
+                self._exit_lock()
+
+    def _visit_call(self, node: ast.Call) -> None:
+        fn = node.func
+        held = frozenset(self.held)
+        # self.method(...) call sites (guard propagation)
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            recv_attr = _self_attr(recv)
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                self.model.calls.append(
+                    CallSite(fn.attr, held, self.method, node.lineno)
+                )
+            # super().method(...) — same-hierarchy propagation
+            elif (
+                isinstance(recv, ast.Call)
+                and isinstance(recv.func, ast.Name)
+                and recv.func.id == "super"
+            ):
+                self.model.calls.append(
+                    CallSite(fn.attr, held, self.method, node.lineno)
+                )
+            # condition-variable discipline: self._cond.wait/notify
+            if recv_attr is not None and recv_attr in self.model.locks:
+                group = self.model.group(recv_attr)
+                if fn.attr == "wait":
+                    kind = "wait" if self.while_depth > 0 else "wait-no-loop"
+                    self.model.cond_events.append(
+                        CondEvent(kind, group, held, self.method, node.lineno)
+                    )
+                elif fn.attr in ("notify", "notify_all"):
+                    self.model.cond_events.append(
+                        CondEvent(
+                            "notify", group, held, self.method, node.lineno
+                        )
+                    )
+            # blocking calls while any lock is held
+            desc = self._blocking_desc(fn, recv_attr)
+            if desc is not None:
+                self.model.blocking.append(
+                    BlockingCall(desc, held, self.method, node.lineno)
+                )
+
+    def _blocking_desc(
+        self, fn: ast.Attribute, recv_attr: Optional[str]
+    ) -> Optional[str]:
+        # waiting on a condition you HOLD releases it — never blocking
+        if recv_attr is not None and recv_attr in self.model.locks:
+            return None
+        if isinstance(fn.value, ast.Name):
+            if (fn.value.id, fn.attr) in BLOCKING_FUNCS:
+                return f"{fn.value.id}.{fn.attr}"
+        if fn.attr.startswith("wait_for_") or fn.attr in (
+            "wait_idle",
+            "wait_quiet",
+        ):
+            return f".{fn.attr}"
+        if fn.attr == "join" and recv_attr in self.model.thread_attrs:
+            return f"self.{recv_attr}.join"
+        if fn.attr in BLOCKING_METHODS:
+            return f".{fn.attr}"
+        return None
+
+
+# --------------------------------------------------------------------------
+# Indexing: find classes, locks, annotations.
+# --------------------------------------------------------------------------
+def index_module(
+    path: str, module: str, tree: ast.Module, guard_lines: Dict[int, str]
+) -> List[ClassModel]:
+    models: List[ClassModel] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        model = ClassModel(name=node.name, module=module, path=path)
+        for base in node.bases:
+            if isinstance(base, ast.Name):
+                model.bases.append(base.id)
+            elif isinstance(base, ast.Attribute):
+                model.bases.append(base.attr)
+        # pass 1: lock/thread attribute discovery + declared guards
+        for fn in node.body:
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            for sub in ast.walk(fn):
+                if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (
+                    sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+                )
+                value = sub.value
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    ctor = _lock_ctor(value) if value is not None else None
+                    if ctor is not None:
+                        kind, shared = ctor
+                        model.locks[attr] = kind
+                        if shared is not None:
+                            model.group_of[attr] = shared
+                    elif value is not None and _is_thread_ctor(value):
+                        model.thread_attrs.add(attr)
+                    declared = guard_lines.get(sub.lineno)
+                    if declared is not None:
+                        model.declared[attr] = declared
+                        model.declared_at[attr] = sub.lineno
+        # method-level caller-holds contracts
+        for fn in node.body:
+            if isinstance(fn, ast.FunctionDef):
+                declared = guard_lines.get(fn.lineno)
+                if declared is not None:
+                    model.method_guard[fn.name] = declared
+                    model.method_guard_at[fn.name] = fn.lineno
+        # NOTE: the held-set method walk is NOT run here — check_paths
+        # merges inherited locks first (walk_model), so a derived class
+        # using a base-assigned lock still registers acquisitions
+        model.node = node
+        models.append(model)
+    return models
+
+
+def walk_model(model: ClassModel) -> None:
+    """Pass 2: the held-set walk per method.  Run AFTER inherited
+    locks/declarations have merged into *model*."""
+    if model.node is None:
+        return
+    for fn in model.node.body:
+        if isinstance(fn, ast.FunctionDef):
+            _MethodWalker(model, fn.name).walk(fn)
+
+
+def _merge_inherited(
+    model: ClassModel, by_name: Dict[str, List[ClassModel]]
+) -> List[ClassModel]:
+    """Package-internal ancestor chain (duplicate names resolve to the
+    same-module definition first); locks/declarations/threads inherit."""
+    out: List[ClassModel] = []
+    queue = list(model.bases)
+    seen = {model.name}
+    while queue:
+        base = queue.pop(0)
+        if base in seen:
+            continue
+        seen.add(base)
+        candidates = by_name.get(base) or []
+        chosen = None
+        for c in candidates:
+            if c.module == model.module:
+                chosen = c
+                break
+        if chosen is None and candidates:
+            chosen = candidates[0]
+        if chosen is None:
+            continue
+        out.append(chosen)
+        queue.extend(chosen.bases)
+    for anc in out:
+        for attr, kind in anc.locks.items():
+            model.locks.setdefault(attr, kind)
+        for attr, leader in anc.group_of.items():
+            model.group_of.setdefault(attr, leader)
+        for attr, lock in anc.declared.items():
+            model.declared.setdefault(attr, lock)
+        for meth, lock in anc.method_guard.items():
+            model.method_guard.setdefault(meth, lock)
+        model.thread_attrs |= anc.thread_attrs
+    return out
+
+
+# --------------------------------------------------------------------------
+# Analysis.
+# --------------------------------------------------------------------------
+def _method_contexts(model: ClassModel) -> Dict[str, frozenset]:
+    """Lock groups GUARANTEED held whenever each method runs: the
+    intersection over internal call sites of (site-held ∪ caller's own
+    context).  Public methods are externally callable → empty context;
+    private helpers with no internal callers likewise (conservative).
+    Declared ``#: guarded-by:`` contracts on a def force the group in."""
+    sites: Dict[str, List[CallSite]] = {}
+    for call in model.calls:
+        sites.setdefault(call.callee, []).append(call)
+    all_groups = frozenset(
+        model.group(a) for a in model.locks
+    )
+    ctx: Dict[str, frozenset] = {}
+    for m in model.methods:
+        forced = model.method_guard.get(m)
+        if forced is not None and forced in model.locks:
+            ctx[m] = frozenset({model.group(forced)})
+        elif (
+            m.startswith("_")
+            and not m.startswith("__")
+            and m in sites
+        ):
+            ctx[m] = all_groups  # optimistic start for the fixpoint
+        else:
+            ctx[m] = frozenset()
+    for _ in range(len(model.methods) + 1):
+        changed = False
+        for m in model.methods:
+            forced = model.method_guard.get(m)
+            base = (
+                frozenset({model.group(forced)})
+                if forced is not None and forced in model.locks
+                else None
+            )
+            if not (
+                m.startswith("_") and not m.startswith("__") and m in sites
+            ):
+                continue
+            inter: Optional[frozenset] = None
+            for call in sites[m]:
+                eff = call.held | ctx.get(call.method, frozenset())
+                inter = eff if inter is None else (inter & eff)
+            new = inter if inter is not None else frozenset()
+            if base is not None:
+                new = new | base
+            if new != ctx[m]:
+                ctx[m] = new
+                changed = True
+        if not changed:
+            break
+    return ctx
+
+
+def _effective(access_held: frozenset, method: str, ctx: Dict[str, frozenset]) -> frozenset:
+    return access_held | ctx.get(method, frozenset())
+
+
+def analyze_class(model: ClassModel, findings: List[Finding]) -> None:
+    if not model.locks:
+        return
+    ctx = _method_contexts(model)
+
+    # -------------------------------------------------- attribute guards
+    by_attr: Dict[str, List[Access]] = {}
+    for acc in model.accesses:
+        if not acc.attr.startswith("_") or acc.attr.startswith("__"):
+            continue
+        if acc.attr in model.locks or acc.attr in model.thread_attrs:
+            continue
+        if acc.method in CONSTRUCTION_METHODS:
+            continue
+        by_attr.setdefault(acc.attr, []).append(acc)
+
+    for attr, accs in sorted(by_attr.items()):
+        declared = model.declared.get(attr)
+        if declared is not None:
+            if declared not in model.locks:
+                findings.append(
+                    Finding(
+                        accs[0].path or model.path,
+                        accs[0].lineno,
+                        "bad-annotation",
+                        f"{model.name}.{attr} declares guarded-by: "
+                        f"{declared} but {model.name} has no such lock "
+                        f"attribute",
+                    )
+                )
+                continue
+            group = model.group(declared)
+            for acc in accs:
+                if group not in _effective(acc.held, acc.method, ctx):
+                    findings.append(
+                        Finding(
+                            acc.path or model.path,
+                            acc.lineno,
+                            "guarded-attr",
+                            f"{model.name}.{attr} is declared guarded-by: "
+                            f"{declared} but is "
+                            f"{'written' if acc.is_store else 'read'} in "
+                            f"{acc.method}() without it",
+                        )
+                    )
+            continue
+        # inference: mixed discipline on mutated, undeclared attrs
+        if attr not in model.mutated:
+            continue  # set once in __init__, read-only after: benign
+        guarded = [
+            a for a in accs if _effective(a.held, a.method, ctx)
+        ]
+        if not guarded:
+            continue  # consistently lock-free: a different design, not mixed
+        # dominant guard = the group most accesses agree on
+        votes: Dict[str, int] = {}
+        for a in guarded:
+            for g in _effective(a.held, a.method, ctx):
+                votes[g] = votes.get(g, 0) + 1
+        dominant = max(sorted(votes), key=lambda g: votes[g])
+        unguarded = [
+            a
+            for a in accs
+            if dominant not in _effective(a.held, a.method, ctx)
+        ]
+        in_methods = {a.method for a in guarded}
+        witnesses = [a for a in unguarded if a.method not in in_methods]
+        if witnesses:
+            w = witnesses[0]
+            g = next(
+                a
+                for a in guarded
+                if dominant in _effective(a.held, a.method, ctx)
+            )
+            findings.append(
+                Finding(
+                    w.path or model.path,
+                    w.lineno,
+                    "mixed-guard",
+                    f"{model.name}.{attr} is guarded by {dominant} in "
+                    f"{g.method}() (line {g.lineno}) but "
+                    f"{'written' if w.is_store else 'read'} without it in "
+                    f"{w.method}() — add the guard, or annotate the "
+                    f"attribute / waive the access",
+                )
+            )
+
+    # ------------------------------------------------ condition discipline
+    for ev in model.cond_events:
+        if ev.kind == "wait-no-loop":
+            findings.append(
+                Finding(
+                    model.path,
+                    ev.lineno,
+                    "wait-not-in-loop",
+                    f"{model.name}.{ev.method}() calls {ev.group}.wait() "
+                    f"outside a while-predicate loop — spurious wakeups "
+                    f"and missed notifies require re-checking the "
+                    f"predicate (or use wait_for)",
+                )
+            )
+        elif ev.kind == "notify":
+            if ev.group not in _effective(ev.held, ev.method, ctx):
+                findings.append(
+                    Finding(
+                        model.path,
+                        ev.lineno,
+                        "notify-unheld",
+                        f"{model.name}.{ev.method}() notifies {ev.group} "
+                        f"without holding it — waiters can miss the wakeup "
+                        f"(and CPython raises RuntimeError)",
+                    )
+                )
+
+    # --------------------------------------------------- blocking under lock
+    for b in model.blocking:
+        eff = _effective(b.held, b.method, ctx)
+        if eff:
+            findings.append(
+                Finding(
+                    model.path,
+                    b.lineno,
+                    "blocking-under-lock",
+                    f"{model.name}.{b.method}() calls blocking "
+                    f"{b.desc}() while holding "
+                    f"{', '.join(sorted(eff))} — every other user of the "
+                    f"lock convoys behind the wait",
+                )
+            )
+
+    # ------------------------------------------------------- lock ordering
+    # edges from explicit nesting + method contexts (a helper whose
+    # callers all hold A acquiring B is an A→B edge)
+    edges: Dict[Tuple[str, str], OrderEdge] = {}
+    for e in model.order_edges:
+        edges.setdefault((e.src, e.dst), e)
+    for acc in model.accesses:
+        if acc.attr in model.locks:
+            group = model.group(acc.attr)
+            for holder in ctx.get(acc.method, frozenset()):
+                if holder != group and acc.held == frozenset():
+                    edges.setdefault(
+                        (holder, group),
+                        OrderEdge(holder, group, acc.method, acc.lineno),
+                    )
+    graph: Dict[str, Set[str]] = {}
+    for (src, dst) in edges:
+        graph.setdefault(src, set()).add(dst)
+    cycle = _find_cycle(graph)
+    if cycle:
+        spots = []
+        for i in range(len(cycle)):
+            pair = (cycle[i], cycle[(i + 1) % len(cycle)])
+            e = edges.get(pair)
+            if e is not None:
+                spots.append(
+                    f"{pair[0]}->{pair[1]} in {e.method}() line {e.lineno}"
+                )
+        first = edges.get((cycle[0], cycle[1 % len(cycle)]))
+        findings.append(
+            Finding(
+                model.path,
+                first.lineno if first else 0,
+                "lock-order-cycle",
+                f"{model.name} acquires its locks in inconsistent order "
+                f"({' ; '.join(spots)}) — a potential deadlock",
+            )
+        )
+
+
+def _find_cycle(graph: Dict[str, Set[str]]) -> Optional[List[str]]:
+    """First cycle in *graph* as a node list, or None (iterative DFS,
+    deterministic order)."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    for nbrs in graph.values():
+        for n in nbrs:
+            color.setdefault(n, WHITE)
+    path: List[str] = []
+
+    def dfs(node: str) -> Optional[List[str]]:
+        color[node] = GRAY
+        path.append(node)
+        for nbr in sorted(graph.get(node, ())):
+            if color[nbr] == GRAY:
+                return path[path.index(nbr):]
+            if color[nbr] == WHITE:
+                found = dfs(nbr)
+                if found:
+                    return found
+        color[node] = BLACK
+        path.pop()
+        return None
+
+    for node in sorted(color):
+        if color[node] == WHITE:
+            found = dfs(node)
+            if found:
+                return found
+    return None
+
+
+# --------------------------------------------------------------------------
+# Driver.
+# --------------------------------------------------------------------------
+def check_paths(
+    roots: List[str], max_waivers: int = MAX_WAIVERS
+) -> Tuple[List[Finding], List[Waiver], int]:
+    """(unwaived findings, all waivers, classes analyzed)."""
+    files: List[Tuple[str, str]] = []
+    for root in roots:
+        if os.path.isfile(root):
+            files.append((root, os.path.splitext(os.path.basename(root))[0]))
+            continue
+        for dirpath, _dirs, names in os.walk(root):
+            if "__pycache__" in dirpath:
+                continue
+            for n in sorted(names):
+                if n.endswith(".py"):
+                    full = os.path.join(dirpath, n)
+                    module = full[:-3].replace(os.sep, ".").replace(
+                        ".__init__", ""
+                    )
+                    files.append((full, module))
+    findings: List[Finding] = []
+    waivers: List[Waiver] = []
+    models: List[ClassModel] = []
+    waived_by_path: Dict[str, Dict[int, Waiver]] = {}
+    for path, module in files:
+        with open(path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        tree = ast.parse(text, filename=path)
+        guard_lines, file_waivers, syntax_findings = parse_annotations(
+            text, path
+        )
+        findings.extend(syntax_findings)
+        waivers.extend(file_waivers)
+        for w in file_waivers:
+            waived_by_path.setdefault(path, {})[w.target] = w
+        models.extend(index_module(path, module, tree, guard_lines))
+    by_name: Dict[str, List[ClassModel]] = {}
+    for m in models:
+        by_name.setdefault(m.name, []).append(m)
+    # inheritance first, THEN the held-set walks: a derived class's
+    # `with self._lock:` must resolve when the lock is assigned by a
+    # base (possibly in another module)
+    ancestors_of: Dict[int, List[ClassModel]] = {}
+    for m in models:
+        ancestors_of[id(m)] = _merge_inherited(m, by_name)
+    for m in models:
+        walk_model(m)
+    raw: List[Finding] = []
+    seen_keys: Set[Tuple[str, int, str]] = set()
+    for m in models:
+        ancestors = ancestors_of[id(m)]
+        # ancestor accesses join the evidence pool so a derived class
+        # touching a base-guarded attr (or vice versa) is caught
+        pooled = ClassModel(
+            name=m.name,
+            module=m.module,
+            path=m.path,
+            bases=m.bases,
+            locks=m.locks,
+            group_of=m.group_of,
+            thread_attrs=m.thread_attrs,
+            declared=m.declared,
+            method_guard=m.method_guard,
+        )
+        pooled.methods = set(m.methods)
+        pooled.mutated = set(m.mutated)
+        pooled.accesses = list(m.accesses)
+        pooled.calls = list(m.calls)
+        pooled.cond_events = list(m.cond_events)
+        pooled.blocking = list(m.blocking)
+        pooled.order_edges = list(m.order_edges)
+        for anc in ancestors:
+            pooled.methods |= anc.methods
+            pooled.mutated |= anc.mutated
+            pooled.accesses.extend(anc.accesses)
+            pooled.calls.extend(anc.calls)
+        class_findings: List[Finding] = []
+        analyze_class(pooled, class_findings)
+        for f in class_findings:
+            # attr findings carry their witness access's true file
+            # (base-class evidence pooled into a subclass anchors — and
+            # waives — at the base's site); dedupe across the base's own
+            # analysis and every subclass's pooled re-analysis
+            key = (f.path, f.lineno, f.category)
+            if key in seen_keys:
+                continue
+            seen_keys.add(key)
+            raw.append(f)
+    # waiver application (syntax findings are never waivable)
+    unwaived: List[Finding] = list(findings)
+    for f in raw:
+        w = waived_by_path.get(f.path, {}).get(f.lineno)
+        if w is not None and w.reason and f.category not in (
+            "waiver-syntax",
+            "bad-annotation",
+        ):
+            w.used = True
+            continue
+        unwaived.append(f)
+    for w in waivers:
+        if w.reason and not w.used:
+            unwaived.append(
+                Finding(
+                    w.path,
+                    w.lineno,
+                    "stale-waiver",
+                    "waiver suppresses no finding — remove it (the "
+                    "inventory must stay honest)",
+                )
+            )
+    if len(waivers) > max_waivers:
+        unwaived.append(
+            Finding(
+                waivers[max_waivers].path,
+                waivers[max_waivers].lineno,
+                "waiver-budget",
+                f"{len(waivers)} waivers exceed the package budget of "
+                f"{max_waivers} — a tree needing more has a design "
+                f"problem, not an annotation problem",
+            )
+        )
+    unwaived.sort(key=lambda f: (f.path, f.lineno, f.category))
+    return unwaived, waivers, len(models)
+
+
+def main(argv: List[str]) -> int:
+    as_json = False
+    max_waivers = MAX_WAIVERS
+    roots: List[str] = []
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--json":
+            as_json = True
+        elif arg == "--max-waivers":
+            i += 1
+            max_waivers = int(argv[i])
+        else:
+            roots.append(arg)
+        i += 1
+    findings, waivers, classes = check_paths(
+        roots or DEFAULT_ROOTS, max_waivers
+    )
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in findings],
+                    "finding_count": len(findings),
+                    "waivers": len(waivers),
+                    "classes": classes,
+                }
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"lockcheck: {len(findings)} finding(s)")
+        else:
+            print(
+                f"lockcheck ok ({classes} classes, "
+                f"{len(waivers)} waiver(s))"
+            )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

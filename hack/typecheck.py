@@ -876,6 +876,71 @@ def _key_suspects(trees: Dict[str, ast.AST]) -> Dict[str, str]:
     return out
 
 
+def check_guard_annotations(
+    path: str, text: str, tree: Optional[ast.AST] = None
+) -> List[str]:
+    """Validate ``#: guarded-by:`` / ``#: lockcheck:`` annotations
+    themselves (ISSUE 14 satellite): the named lock attribute must
+    exist on the class and be a ``threading.Lock``/``RLock``/
+    ``Condition`` assignment, and every annotation must attach to a
+    ``self.<attr> = ...`` assignment or a method ``def`` — a typo'd
+    annotation must fail lint here, not silently guard nothing.
+    Reuses the one annotation parser (hack/lockcheck.py) so the two
+    gates can never disagree about syntax."""
+    import lockcheck
+
+    problems: List[str] = []
+    guards, _waivers, syntax = lockcheck.parse_annotations(text, path)
+    for finding in syntax:
+        problems.append(finding.render())
+    if not guards:
+        return problems
+    if tree is None:
+        tree = ast.parse(text, filename=path)
+    models = lockcheck.index_module(path, path, tree, guards)
+    local_classes = {m.name for m in models}
+    consumed: Dict[int, Tuple[str, str, str]] = {}
+    for m in models:
+        for attr, line in m.declared_at.items():
+            consumed[line] = (m.name, attr, m.declared[attr])
+        for meth, line in m.method_guard_at.items():
+            consumed[line] = (m.name, meth + "()", m.method_guard[meth])
+    by_name = {m.name: m for m in models}
+    for line, lockname in sorted(guards.items()):
+        owner = consumed.get(line)
+        if owner is None:
+            problems.append(
+                f"{path}:{line}: guarded-by annotation attaches to no "
+                f"self-attribute assignment or method def"
+            )
+            continue
+        cls_name, target, _ = owner
+        model = by_name[cls_name]
+        # resolve the lock through same-file bases too
+        locks = dict(model.locks)
+        queue = list(model.bases)
+        external_base = False
+        while queue:
+            base = queue.pop(0)
+            if base in by_name:
+                for k, v in by_name[base].locks.items():
+                    locks.setdefault(k, v)
+                queue.extend(by_name[base].bases)
+            elif base not in ("object", "Protocol"):
+                external_base = True
+        if lockname in locks:
+            continue
+        if external_base:
+            continue  # the lock may live on a cross-module base
+        problems.append(
+            f"{path}:{line}: {cls_name}.{target} declares guarded-by: "
+            f"{lockname} but {cls_name} assigns no threading.Lock/RLock/"
+            f"Condition attribute of that name — typo'd annotations "
+            f"guard nothing"
+        )
+    return problems
+
+
 def check_paths(roots: List[str]) -> List[str]:
     files: List[Tuple[str, str]] = []  # (path, module)
     for root in roots:
@@ -894,16 +959,18 @@ def check_paths(roots: List[str]) -> List[str]:
                     files.append((full, module))
     index: Dict[str, Indexer] = {}
     trees: Dict[str, ast.AST] = {}
+    problems: List[str] = []
     for path, module in files:
         with open(path, "r", encoding="utf-8") as fh:
-            tree = ast.parse(fh.read(), filename=path)
+            text = fh.read()
+        tree = ast.parse(text, filename=path)
         idx = Indexer(module)
         idx.is_package = os.path.basename(path) == "__init__.py"
         idx.visit(tree)
         idx.finish(tree)
         index[module] = idx
         trees[module] = tree
-    problems: List[str] = []
+        problems.extend(check_guard_annotations(path, text, tree))
     suspects = _key_suspects(trees)
     for path, module in files:
         Checker(module, path, index, problems, suspects).visit(trees[module])

@@ -882,7 +882,8 @@ def cmd_chaos(args: argparse.Namespace) -> int:
             s = chaos_mod.SCENARIOS[name]
             axes = (
                 f"transport={'|'.join(s.transports)} "
-                f"gates={'|'.join(s.gates)}"
+                f"gates={'|'.join(s.gates)} "
+                f"driver={'|'.join(s.drivers)}"
             )
             print(f"{name:<26} [{axes}]\n    {s.description}")
         return 0
@@ -931,6 +932,8 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         campaign.scenarios = tuple(args.scenario)
     if args.transport:
         campaign.transports = tuple(args.transport)
+    if args.driver:
+        campaign.drivers = tuple(args.driver)
     if not campaign.cells():
         print(
             "the campaign selects zero cells (scenario/transport axes "
@@ -1040,9 +1043,15 @@ def cmd_profile(args: argparse.Namespace) -> int:
             file=sys.stderr,
         )
         return 2
+    locks_payload = None
     if args.file:
         try:
             snapshot, _ = _load_profile_dump(args.file)
+            if getattr(args, "locks", False):
+                with open(args.file, "r", encoding="utf-8") as fh:
+                    raw = json.load(fh)
+                if isinstance(raw, dict):
+                    locks_payload = raw.get("locks")
         except FileNotFoundError:
             print(f"profile dump not found: {args.file}", file=sys.stderr)
             return 2
@@ -1060,15 +1069,21 @@ def cmd_profile(args: argparse.Namespace) -> int:
         import urllib.request
 
         url = args.url.rstrip("/") + "/debug/profile"
+        params = []
         if args.seconds:
-            url += f"?seconds={args.seconds:g}"
+            params.append(f"seconds={args.seconds:g}")
+        if getattr(args, "locks", False):
+            params.append("locks=1")
+        if params:
+            url += "?" + "&".join(params)
         try:
             with urllib.request.urlopen(
                 url, timeout=max(30.0, args.seconds + 30.0)
             ) as resp:
-                snapshot = profiling.snapshot_from_payload(
-                    json.loads(resp.read().decode())
-                )
+                payload = json.loads(resp.read().decode())
+                if isinstance(payload, dict):
+                    locks_payload = payload.get("locks")
+                snapshot = profiling.snapshot_from_payload(payload)
         except (urllib.error.URLError, OSError, ValueError) as err:
             print(f"cannot capture from {url}: {err}", file=sys.stderr)
             return 2
@@ -1085,9 +1100,23 @@ def cmd_profile(args: argparse.Namespace) -> int:
     elif args.fmt == "speedscope":
         print(json.dumps(profiling.to_speedscope(snapshot)))
     elif args.json:
-        print(json.dumps(snapshot))
+        out = snapshot
+        if getattr(args, "locks", False) and locks_payload is not None:
+            out = dict(snapshot, locks=locks_payload)
+        print(json.dumps(out))
     else:
         print(profiling.render_report(snapshot, top=args.top))
+        if getattr(args, "locks", False):
+            from .obs import racewatch
+
+            if locks_payload is None:
+                print(
+                    "\nracewatch: no lock data in this source (serve "
+                    "/debug/profile?locks=1 from a RACEWATCH=1 process)"
+                )
+            else:
+                print()
+                print(racewatch.render_report(locks_payload, top=args.top))
     return 0
 
 
@@ -1539,6 +1568,15 @@ def main(argv=None) -> int:
         help="restrict the transport axis (repeatable)",
     )
     ch.add_argument(
+        "--driver",
+        action="append",
+        choices=("polling", "event"),
+        default=[],
+        help="restrict the reconcile-driver axis (repeatable): "
+        "'polling' = one pass per cycle, 'event' = passes scheduled "
+        "by workqueue wakeups (journal deltas, worker completions)",
+    )
+    ch.add_argument(
         "--seed",
         type=int,
         default=None,
@@ -1617,6 +1655,14 @@ def main(argv=None) -> int:
         type=int,
         default=10,
         help="rows in the top-frames / diff tables",
+    )
+    pf.add_argument(
+        "--locks",
+        action="store_true",
+        help="append the racewatch lock section (per-site hold/"
+        "contention stats + lock-order cycles): with --url fetches "
+        "?locks=1, with --file reads the dump's locks key (only "
+        "present when the serving process ran RACEWATCH=1)",
     )
     pf.add_argument(
         "--json",

@@ -77,7 +77,7 @@ class InformerCache:
         #: waiter prove "the view has not advanced since I last checked
         #: my predicate", closing the lost-wakeup race between a
         #: predicate check and the wait.
-        self._version = 0
+        self._version = 0  #: guarded-by: _lock
         #: Elects the single stream pump in :meth:`wait_for_update`.
         #: Deliberately NOT ``_refresh_serial``: the pump sleeps on the
         #: held-event condition while holding its election, and readers
@@ -94,13 +94,13 @@ class InformerCache:
         # cache-visibility waits then time out).  RLock because the 410
         # path (_refresh -> sync) re-enters.
         self._refresh_serial = threading.RLock()
-        self._snapshot: Dict[Key, JsonObj] = {}
+        self._snapshot: Dict[Key, JsonObj] = {}  #: guarded-by: _lock
         self._last_seq = 0
-        self._last_sync = float("-inf")
+        self._last_sync = float("-inf")  #: guarded-by: _lock
         #: set ONLY by sync() — the externally-fed seeding check must
         #: not be satisfied by an ingested delta batch (deltas atop an
         #: unseeded view would silently miss every pre-existing object)
-        self._seeded = False
+        self._seeded = False  #: guarded-by: _lock
         #: full relists performed (observable: tests assert refreshes are
         #: incremental, ops can spot expiry churn)
         self.full_syncs = 0
@@ -334,6 +334,7 @@ class InformerCache:
         with self._update_cond:
             if seen is not None and self._version != seen:
                 return
+            #: lockcheck: unguarded(deliberate bounded nap, not a predicate wait — callers re-check their own predicate and the lag gate bounds staleness)
             self._update_cond.wait(
                 min(timeout, max(self.lag_seconds, 0.001))
             )

@@ -303,15 +303,17 @@ class InMemoryCluster:
 
     @property
     def _journal_cap(self) -> int:
-        return self._journal_cap_floor
+        with self._lock:  # RLock: safe from under-lock readers too
+            return self._journal_cap_floor
 
     @_journal_cap.setter
     def _journal_cap(self, value: int) -> None:
         """Pin journal retention to exactly *value* entries.  Assigning
         disables store-size auto-scaling — tests that shrink the window
         to provoke 410 Gone need the cap to mean what they set."""
-        self._journal_cap_floor = value
-        self._journal_autoscale = False
+        with self._lock:
+            self._journal_cap_floor = value
+            self._journal_autoscale = False
 
     # ------------------------------------------------------------ index upkeep
     def _store_put(self, key: Key, obj: JsonObj) -> None:

@@ -1086,6 +1086,7 @@ class KubeApiClient:
             kinds = sorted(kind)
         else:
             kinds = list(KIND_REGISTRY)
+        #: lockcheck: unguarded(immutable frozenset swapped whole; start/stop_held_watches are quiesced setup/teardown seams on the single consumer thread)
         if self._held_kinds:
             held_part = [k for k in kinds if k in self._held_kinds]
             poll_part = [k for k in kinds if k not in self._held_kinds]

@@ -322,18 +322,18 @@ class WriteDispatcher:
         self._overload_retries = overload_retries
         self._overload_backoff = overload_backoff_s
         self._cond = threading.Condition()
-        self._order: deque = deque()  # unclaimed entries, submit order
-        self._key_queues: Dict[Tuple[str, str, str], deque] = {}
-        self._inflight_keys: set = set()
-        self._inflight = 0  # claimed entries not yet completed
+        self._order: deque = deque()  #: guarded-by: _cond (unclaimed entries, submit order)
+        self._key_queues: Dict[Tuple[str, str, str], deque] = {}  #: guarded-by: _cond
+        self._inflight_keys: set = set()  #: guarded-by: _cond
+        self._inflight = 0  #: guarded-by: _cond (claimed entries not yet completed)
         #: claimed BATCHES not yet completed — the adaptive throttle's
         #: unit (comparing entry counts against the worker-unit target
         #: would serialize batching mode: one 64-write batch already
         #: exceeds any worker count)
-        self._inflight_batches = 0
-        self._flushing = 0  # >0 disables the coalesce-window hold
-        self._closed = False
-        self._threads: List[threading.Thread] = []
+        self._inflight_batches = 0  #: guarded-by: _cond
+        self._flushing = 0  #: guarded-by: _cond (>0 disables the coalesce-window hold)
+        self._closed = False  #: guarded-by: _cond
+        self._threads: List[threading.Thread] = []  #: guarded-by: _cond
         # metric handles bound ONCE: funneling every worker's update
         # through the registry's create-or-get lock convoyed the submit
         # path at fleet scale (profiled ~300 µs/call under 16 workers)
@@ -354,9 +354,12 @@ class WriteDispatcher:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
-        for t in self._threads:
+            # snapshot under the lock (racing _spawn_locked appends);
+            # join OUTSIDE it — workers need the lock to exit
+            threads = list(self._threads)
+            self._threads = []
+        for t in threads:
             t.join(timeout=5.0)
-        self._threads = []
 
     def _spawn_locked(self) -> None:
         # one worker per queued batch's worth of work, up to the cap;

@@ -158,11 +158,13 @@ class LeaderElector:
                 # us (and re-acquire once the store heals)
                 logger.warning("%s: acquire/renew errored: %s", self.identity, err)
                 renewed = False
+            with self._lock:
+                am_leader = self._is_leader
             if renewed:
                 last_renew = time.monotonic()
-                if not self._is_leader:
+                if not am_leader:
                     self._promote()
-            elif self._is_leader:
+            elif am_leader:
                 # renewal failed; demote once the deadline passes — before
                 # the lease TTL, so we stop acting while still nominally
                 # the holder on the server

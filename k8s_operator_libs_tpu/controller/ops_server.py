@@ -79,6 +79,7 @@ from urllib.parse import parse_qs
 
 from .. import metrics as metrics_mod
 from ..obs import profiling as profiling_mod
+from ..obs import racewatch as racewatch_mod
 from ..obs import tracing as tracing_mod
 
 logger = logging.getLogger(__name__)
@@ -316,6 +317,12 @@ class OpsServer:
             payload = snapshot
             if (query.get("heap") or [""])[0] in ("1", "true"):
                 payload = dict(snapshot, heap=profiling_mod.heap_snapshot())
+            if (query.get("locks") or [""])[0] in ("1", "true"):
+                # racewatch lock stats (installed: per-site hold/
+                # contention + the lock-order graph; else a stub that
+                # says how to turn it on) — the longest-held locks as
+                # named frames beside the sampled ones
+                payload = dict(payload, locks=racewatch_mod.report())
         else:
             return (
                 400,

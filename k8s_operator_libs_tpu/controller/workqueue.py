@@ -75,17 +75,17 @@ class WorkQueue:
         # deque, not list: get() pops from the head, and list.pop(0)
         # is O(n) — under a fleet-sized burst the queue alone would
         # cost O(n²).
-        self._queue: Deque[Hashable] = deque()
-        self._queued: Set[Hashable] = set()
-        self._processing: Set[Hashable] = set()
-        self._dirty: Set[Hashable] = set()
-        self._shutting_down = False
+        self._queue: Deque[Hashable] = deque()  #: guarded-by: _cond
+        self._queued: Set[Hashable] = set()  #: guarded-by: _cond
+        self._processing: Set[Hashable] = set()  #: guarded-by: _cond
+        self._dirty: Set[Hashable] = set()  #: guarded-by: _cond
+        self._shutting_down = False  #: guarded-by: _cond
         # queue-wait attribution (observability): when each queued item
         # was enqueued, and — while an item is being processed — how long
         # it sat queued before get() handed it out (the "queue-wait" span
         # on the reconcile trace).
-        self._enqueued_at: Dict[Hashable, float] = {}
-        self._last_wait: Dict[Hashable, float] = {}
+        self._enqueued_at: Dict[Hashable, float] = {}  #: guarded-by: _cond
+        self._last_wait: Dict[Hashable, float] = {}  #: guarded-by: _cond
         #: (item, trigger) observer fired for every ACCEPTED add — the
         #: feed for ``reconcile_wakeups_total{trigger}``.  Called
         #: outside the queue lock.
@@ -262,13 +262,13 @@ class RateLimitedQueue(WorkQueue):
         super().__init__(wakeup_listener=wakeup_listener)
         self._limiter = rate_limiter or ExponentialBackoffRateLimiter()
         self._delay_cond = threading.Condition()
-        self._heap: List[Tuple[float, int, Hashable, str]] = []
+        self._heap: List[Tuple[float, int, Hashable, str]] = []  #: guarded-by: _delay_cond
         #: earliest live deadline per item (monotonic due time) — heap
         #: entries not matching it are stale and skipped on pop
-        self._armed: Dict[Hashable, float] = {}
+        self._armed: Dict[Hashable, float] = {}  #: guarded-by: _delay_cond
         # items popped from the heap but not yet add()ed — bridges the
         # cross-lock handoff so pending_work() never under-counts
-        self._handoff = 0
+        self._handoff = 0  #: guarded-by: _delay_cond
         self._seq = itertools.count()
         self._timer = threading.Thread(target=self._timer_loop, daemon=True)
         self._timer.start()

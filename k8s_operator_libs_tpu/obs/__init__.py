@@ -11,7 +11,7 @@ live in :mod:`..metrics` (predating this package); the HTTP surface
 for all of them is :class:`~..controller.ops_server.OpsServer`.
 """
 
-from . import events, history, overhead, profiling, slo
+from . import events, history, overhead, profiling, racewatch, slo
 from .tracing import (
     Span,
     TraceContextFilter,

@@ -224,8 +224,8 @@ class DecisionEventLog:
 
         self.instance = uuid.uuid4().hex[:12]
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
-        self._seq = 0
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()  #: guarded-by: _lock
+        self._seq = 0  #: guarded-by: _lock
         self.dropped_events = 0
         #: (registry, Counter) handle cache: re-resolving the counter
         #: through the registry's create-or-get lock PER EMISSION was
@@ -323,6 +323,7 @@ class DecisionEventLog:
         # 16k-target wave must not stall /debug/events readers for the
         # whole walk.  Inner loop runs on local aliases — it IS the
         # fully-gated fleet's per-node hot path.
+        #: lockcheck: unguarded(alias hoist for the hot loop — the _entries binding never changes after __init__; every mutation below runs under the chunked _lock holds)
         entries = self._entries
         entries_get = entries.get
         move_to_end = entries.move_to_end
@@ -384,8 +385,10 @@ class DecisionEventLog:
         total = len(events)
         if limit is not None and limit > 0:
             events = events[-limit:]
+        with self._lock:
+            emitted = self._seq
         return {
-            "emitted": self._seq,
+            "emitted": emitted,
             "entries": total,
             "droppedEvents": self.dropped_events,
             "events": events,

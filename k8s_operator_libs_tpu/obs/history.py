@@ -64,10 +64,10 @@ class MetricsHistory:
         self.max_samples = max_samples
         self.retention_seconds = retention_seconds
         self._lock = threading.Lock()
-        self._series: Dict[str, deque] = {}
+        self._series: Dict[str, deque] = {}  #: guarded-by: _lock
         #: Series whose source stopped reporting get pruned wholesale
         #: once every sample ages out (see :meth:`record`).
-        self._last_seen: Dict[str, float] = {}
+        self._last_seen: Dict[str, float] = {}  #: guarded-by: _lock
         #: Global record generation + per-series generation stamps: the
         #: cadence-independent staleness oracle.  A series whose stamp
         #: lags the global counter by more than STALE_GENERATIONS never
@@ -75,8 +75,8 @@ class MetricsHistory:
         #: from the block mid-rollout), and a frozen newest sample must
         #: not keep satisfying (or keep breaching) a sustained condition
         #: for the rest of the retention window.
-        self._gen = 0
-        self._series_gen: Dict[str, int] = {}
+        self._gen = 0  #: guarded-by: _lock
+        self._series_gen: Dict[str, int] = {}  #: guarded-by: _lock
 
     # -------------------------------------------------------------- feeding
     def record(

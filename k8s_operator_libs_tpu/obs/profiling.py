@@ -386,7 +386,9 @@ class SamplingProfiler:
             # lifetime cost over lifetime wall (prior runs included) —
             # a per-run denominator would inflate N-fold over N
             # stop/start cycles while the numerator stays cumulative
+            #: lockcheck: unguarded(benign racy read feeding the overhead gauge; taking _life_lock here would convoy against stop()'s held-lock join for its full timeout)
             elapsed = self._elapsed_accum + (
+                #: lockcheck: unguarded(same racy-gauge read as the line above)
                 time.monotonic() - (self._started_mono or t0)
             )
             if elapsed > 0:

@@ -861,6 +861,12 @@ class Scenario:
     description: str
     transports: Tuple[str, ...] = ("inmem", "http")
     gates: Tuple[str, ...] = ("on", "off")
+    #: reconcile drivers the scenario supports: "polling" runs one pass
+    #: per cycle unconditionally (the reference consumers' cadence);
+    #: "event" schedules passes through a real workqueue + WakeupSource
+    #: (journal-delta watch wakes, async worker-completion wakes, a
+    #: bounded fallback) — the event-driven reconcile under faults
+    drivers: Tuple[str, ...] = ("polling", "event")
     #: install the fault before the rollout starts: fn(cell)
     setup: Optional[Callable] = None
     #: per-cycle hook (policy edits, journal rolls, failovers): fn(cell, cycle)
@@ -1230,8 +1236,16 @@ class Campaign:
     scenarios: Tuple[str, ...] = tuple(SCENARIOS)
     transports: Tuple[str, ...] = ("inmem", "http")
     gates: Tuple[str, ...] = ("on", "off")
+    #: the event-driven-vs-polling driver axis (ROADMAP item 5
+    #: leftover).  "event" cells run the same scenario with reconciles
+    #: SCHEDULED by a workqueue + WakeupSource instead of per-cycle
+    #: polling, so fault paths exercise the wakeup machinery too.  The
+    #: default matrix crosses it for inmem cells only: the event axis
+    #: probes scheduling, which is transport-independent — crossing it
+    #: with http as well would double campaign wall for no new edge.
+    drivers: Tuple[str, ...] = ("polling", "event")
 
-    def cells(self) -> List[Tuple[str, str, str]]:
+    def cells(self) -> List[Tuple[str, str, str, str]]:
         out = []
         for name in self.scenarios:
             scenario = SCENARIOS.get(name)
@@ -1246,7 +1260,12 @@ class Campaign:
                 for gates in self.gates:
                     if gates not in scenario.gates:
                         continue
-                    out.append((name, transport, gates))
+                    for driver in self.drivers:
+                        if driver not in scenario.drivers:
+                            continue
+                        if driver != "polling" and transport != "inmem":
+                            continue  # see the drivers docstring
+                        out.append((name, transport, gates, driver))
         return out
 
 
@@ -1275,7 +1294,12 @@ def campaign_from_dict(data: dict) -> Campaign:
         else ("inmem", "http")
     )
     gates = tuple(axes["gates"]) if "gates" in axes else ("on", "off")
-    if not transports or not gates:
+    drivers = (
+        tuple(axes["driver"])
+        if "driver" in axes
+        else ("polling", "event")
+    )
+    if not transports or not gates or not drivers:
         raise ValueError("campaign file declares an empty axis")
     fleet = int(data["fleet"]) if "fleet" in data else 8
     if fleet < 1:
@@ -1287,6 +1311,7 @@ def campaign_from_dict(data: dict) -> Campaign:
         scenarios=scenarios,
         transports=transports,
         gates=gates,
+        drivers=drivers,
     )
     for t in campaign.transports:
         if t not in ("inmem", "http"):
@@ -1294,15 +1319,22 @@ def campaign_from_dict(data: dict) -> Campaign:
     for g in campaign.gates:
         if g not in ("on", "off"):
             raise ValueError(f"unknown gates axis value {g!r}")
+    for d in campaign.drivers:
+        if d not in ("polling", "event"):
+            raise ValueError(f"unknown driver axis value {d!r}")
     campaign.cells()  # validates scenario names
     return campaign
 
 
 def cell_seed(campaign_seed: int, scenario: str, transport: str, gates: str,
-              fleet_size: int) -> int:
+              fleet_size: int, driver: str = "polling") -> int:
     """The documented per-cell seed derivation: stable across runs and
-    processes (crc32, not hash() — PYTHONHASHSEED must not matter)."""
+    processes (crc32, not hash() — PYTHONHASHSEED must not matter).
+    ``polling`` (the pre-axis default) keys exactly as before, so every
+    historical cell seed is unchanged."""
     key = f"{campaign_seed}:{scenario}:{transport}:{gates}:{fleet_size}"
+    if driver != "polling":
+        key += f":{driver}"
     return zlib.crc32(key.encode())
 
 
@@ -1341,12 +1373,14 @@ class CampaignCell:
         gates: str,
         fleet_size: int,
         seed: int,
+        driver: str = "polling",
     ):
         self.scenario = scenario
         self.transport = transport
         self.gates = gates
         self.fleet_size = fleet_size
         self.seed = seed
+        self.driver = driver
         self.rng = random.Random(seed)
         self.notes: Dict[str, object] = {}
         self.logs: List[events_mod.DecisionEventLog] = []
@@ -1376,6 +1410,28 @@ class CampaignCell:
             # generous retention so the audit tape can replay the whole
             # cell (storm scenarios re-pin it tight in their setup hook)
             self.store._journal_cap = 500_000
+            # event driver: a real workqueue + WakeupSource schedule
+            # the passes (journal tee below + worker completions via
+            # manager.set_wakeup_source); the polling driver runs one
+            # pass per cycle unconditionally, exactly as before
+            self.queue = None
+            self.wakeup = None
+            self._watch_cursor = 0
+            self._skipped_streak = 0
+            self._pending_request = None
+            if driver == "event":
+                from ..controller.upgrade_reconciler import UPGRADE_REQUEST
+                from ..controller.wakeup import WakeupSource
+                from ..controller.workqueue import RateLimitedQueue
+
+                def _count_wakeup(_item, trigger: str) -> None:
+                    counts = self.notes.setdefault("wakeups", {})
+                    counts[trigger] = counts.get(trigger, 0) + 1
+
+                self.queue = RateLimitedQueue(
+                    wakeup_listener=_count_wakeup
+                )
+                self.wakeup = WakeupSource(self.queue, UPGRADE_REQUEST)
             self.client = self.store
             if transport == "http":
                 from ..cluster import (
@@ -1423,7 +1479,7 @@ class CampaignCell:
             kwargs.setdefault("reads_from_cache", True)
         else:
             cache = InformerCache(self.client, lag_seconds=0.0)
-        return ClusterUpgradeStateManager(
+        manager = ClusterUpgradeStateManager(
             self.client,
             cache=cache,
             cache_sync_timeout_seconds=2.0,
@@ -1431,6 +1487,13 @@ class CampaignCell:
             decision_event_sink=sink,
             **kwargs,
         )
+        if self.wakeup is not None:
+            # async drain/pod completions wake the queue — restart
+            # replacements (ha-failover, operator-crash) re-attach here
+            attach = getattr(manager, "set_wakeup_source", None)
+            if attach is not None:
+                attach(self.wakeup)
+        return manager
 
     def restart_operator(self) -> None:
         """The HA failover / crash replacement: a NEW process — fresh
@@ -1450,6 +1513,42 @@ class CampaignCell:
             self.notes.get("operator_restarts", 0) + 1
         )
 
+    # --------------------------------------------------- event driver
+    def begin_cycle(self) -> bool:
+        """Whether this cycle runs a reconcile pass.  Polling: always.
+        Event: only when a wakeup scheduled one — the journal tee fires
+        a ``watch`` wake on any delta since the last cycle (the cell's
+        stand-in for the controller's watch loop), worker completions
+        arrive through the manager's WakeupSource, and after 3 quiet
+        cycles a ``fallback`` wake fires (the demoted safety-net
+        cadence), so gate clocks still make progress."""
+        if self.driver != "event":
+            return True
+        seq = self.store.journal_seq()
+        if seq > self._watch_cursor:
+            self._watch_cursor = seq
+            self.wakeup.wake("watch")
+        item = self.queue.get(timeout=0)
+        if item is None:
+            self._skipped_streak += 1
+            self.notes["driver_skipped_cycles"] = (
+                self.notes.get("driver_skipped_cycles", 0) + 1
+            )
+            if self._skipped_streak < 4:
+                return False
+            self.wakeup.wake("fallback")
+            item = self.queue.get(timeout=0)
+            if item is None:
+                return False
+        self._skipped_streak = 0
+        self._pending_request = item
+        return True
+
+    def end_cycle(self) -> None:
+        if self._pending_request is not None:
+            self.queue.done(self._pending_request)
+            self._pending_request = None
+
     def decisions(self) -> List[dict]:
         """The cell's merged live decision stream across operator
         restarts: per-process sequences re-based so first-occurrence
@@ -1461,6 +1560,8 @@ class CampaignCell:
             if self.manager is not None:
                 self.manager.shutdown()
         finally:
+            if getattr(self, "queue", None) is not None:
+                self.queue.shutdown()  # stops the delay-timer thread
             if self._held:
                 try:
                     self.client.stop_held_watches()
@@ -1500,11 +1601,14 @@ def run_cell(
     gates: str,
     fleet_size: int,
     seed: int,
+    driver: str = "polling",
 ) -> dict:
     """Run one campaign cell end-to-end and check every invariant.
     Returns the cell's scorecard row."""
     started = time.monotonic()
-    cell = CampaignCell(scenario, transport, gates, fleet_size, seed)
+    cell = CampaignCell(
+        scenario, transport, gates, fleet_size, seed, driver=driver
+    )
     try:
         cell.audit = AuditTape(cell.store, cell.policy)
         # a short healthy era first (faults already live — see
@@ -1519,7 +1623,11 @@ def run_cell(
             cycles = cycle + 1
             if scenario.tick is not None:
                 scenario.tick(cell, cycle)
-            _reconcile_once(cell)
+            if cell.begin_cycle():
+                try:
+                    _reconcile_once(cell)
+                finally:
+                    cell.end_cycle()
             cell.audit.collect()
             if cell.fleet.converged(scenario.target, reader=cell.store):
                 converged = True
@@ -1548,8 +1656,10 @@ def run_cell(
             "scenario": scenario.name,
             "transport": transport,
             "gates": gates,
+            "driver": driver,
             "fleet": fleet_size,
             "seed": seed,
+            "wakeups": dict(cell.notes.get("wakeups") or {}),
             "passed": not violations,
             "converged": converged,
             "cycles": cycles,
@@ -1600,16 +1710,22 @@ def run_campaign(campaign: Campaign, progress=None) -> dict:
     """Run every cell of *campaign*; returns the scorecard artifact."""
     started = time.monotonic()
     rows = []
-    for scenario_name, transport, gates in campaign.cells():
+    for scenario_name, transport, gates, driver in campaign.cells():
         scenario = SCENARIOS[scenario_name]
         seed = cell_seed(
             campaign.seed, scenario_name, transport, gates,
-            campaign.fleet_size,
+            campaign.fleet_size, driver,
         )
         if progress is not None:
-            progress(f"cell {scenario_name}/{transport}/gates-{gates} ...")
+            progress(
+                f"cell {scenario_name}/{transport}/gates-{gates}"
+                f"/{driver} ..."
+            )
         rows.append(
-            run_cell(scenario, transport, gates, campaign.fleet_size, seed)
+            run_cell(
+                scenario, transport, gates, campaign.fleet_size, seed,
+                driver=driver,
+            )
         )
     passed = sum(1 for r in rows if r["passed"])
     return {
@@ -1641,6 +1757,7 @@ def deterministic_scorecard(scorecard: dict) -> dict:
                 "scenario": r["scenario"],
                 "transport": r["transport"],
                 "gates": r["gates"],
+                "driver": r.get("driver", "polling"),
                 "seed": r["seed"],
                 "passed": r["passed"],
                 "converged": r["converged"],
@@ -1667,7 +1784,9 @@ def render_scorecard(scorecard: dict) -> str:
         mark = "PASS" if row["passed"] else "FAIL"
         lines.append(
             f"  [{mark}] {row['scenario']:<24} {row['transport']:<6} "
-            f"gates={row['gates']:<4} cycles={row['cycles']:<4} "
+            f"gates={row['gates']:<4} "
+            f"driver={row.get('driver', 'polling'):<8} "
+            f"cycles={row['cycles']:<4} "
             f"decisions={row['decisions']:<4} wall={row['wall_s']:.1f}s"
         )
         for v in row["violations"]:
@@ -1679,6 +1798,7 @@ def compact_scorecard(scorecard: dict) -> dict:
     """The bench-tail slice: headline numbers only, prose-free."""
     failed = [
         f"{r['scenario']}/{r['transport']}/{r['gates']}"
+        f"/{r.get('driver', 'polling')}"
         for r in scorecard.get("cells") or []
         if not r["passed"]
     ]

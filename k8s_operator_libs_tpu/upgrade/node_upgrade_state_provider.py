@@ -66,10 +66,10 @@ class _WritePipeline:
         self.dispatcher = dispatcher
         self._lock = threading.Lock()
         self._done = threading.Condition(self._lock)
-        self._submitted = 0
-        self._completed = 0
-        self._rvs: List[Tuple[str, int]] = []
-        self._errors: List[BaseException] = []
+        self._submitted = 0  #: guarded-by: _done
+        self._completed = 0  #: guarded-by: _done
+        self._rvs: List[Tuple[str, int]] = []  #: guarded-by: _done
+        self._errors: List[BaseException] = []  #: guarded-by: _done
 
     def submit(self, name: str, patch: JsonObj) -> None:
         from ..cluster.writepipeline import WriteOp

@@ -138,10 +138,10 @@ class ClusterStateIndex:
         self.externally_fed = externally_fed
         self._lock = threading.RLock()
         # Pristine store — advanced by the journal, never handed out.
-        self._nodes: Dict[str, JsonObj] = {}
-        self._daemon_sets: Dict[str, JsonObj] = {}  # uid -> DS
-        self._pods: Dict[PodKey, JsonObj] = {}
-        self._pods_by_node: Dict[str, Set[PodKey]] = {}
+        self._nodes: Dict[str, JsonObj] = {}  #: guarded-by: _lock
+        self._daemon_sets: Dict[str, JsonObj] = {}  #: guarded-by: _lock (uid -> DS)
+        self._pods: Dict[PodKey, JsonObj] = {}  #: guarded-by: _lock
+        self._pods_by_node: Dict[str, Set[PodKey]] = {}  #: guarded-by: _lock
         # Materialized view — the objects handed to ApplyState, reused
         # across builds until their inputs go dirty.
         self._view_nodes: Dict[str, JsonObj] = {}
@@ -155,7 +155,7 @@ class ClusterStateIndex:
         # per-pod ownership scan.
         self._order: Optional[List[PodKey]] = None
         self._order_counts: Dict[str, int] = {}
-        self._dirty: Set[str] = set()
+        self._dirty: Set[str] = set()  #: guarded-by: _lock
         self._all_dirty = True
         # Un-ACKed scan debt: the dirty information handed to the most
         # recent build_state.  It stays owed — merged into every
@@ -164,7 +164,7 @@ class ClusterStateIndex:
         # this, a build whose apply never ran (paused policy, abort,
         # equivalence probes, the plan sandbox) would silently consume
         # change information and strand nodes outside the scoped scans.
-        self._pending: Set[str] = set()
+        self._pending: Set[str] = set()  #: guarded-by: _lock
         self._pending_all = False
         self._seeded = False
         self._last_seq = 0

@@ -138,7 +138,7 @@ class FlightRecorder:
         #: check per hook (the bench's off-side A/B).
         self.enabled = enabled
         self._lock = threading.Lock()
-        self._nodes: "OrderedDict[str, _NodeTimeline]" = OrderedDict()
+        self._nodes: "OrderedDict[str, _NodeTimeline]" = OrderedDict()  #: guarded-by: _lock
         #: Timelines evicted because the ring was full (observable, like
         #: the tracer's orphan_spans).
         self.evicted_timelines = 0
@@ -184,6 +184,7 @@ class FlightRecorder:
         # between chunks is harmless: the sweep is truth-reconciling by
         # design and the next build re-observes.
         chunk = 256
+        #: lockcheck: unguarded(alias hoist for the sweep — the _nodes binding never changes after __init__; every mutation below runs under the chunked _lock holds)
         nodes = self._nodes
         seen = set()
         for bucket, node_states in state.node_states.items():

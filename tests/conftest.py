@@ -38,6 +38,29 @@ except ImportError:
     # collect and run without it (test_tpu_integration imports jax lazily).
     pass
 
+# RACEWATCH=1: instrument every threading.Lock/RLock/Condition the
+# suite creates (the `make verify-race` dynamic pass).  Loaded by FILE
+# PATH, before any library import below, so even the package's
+# module-level locks are born watched; state is stashed on `threading`,
+# so the normal `k8s_operator_libs_tpu.obs.racewatch` import shares it.
+_racewatch = None
+if os.environ.get("RACEWATCH") == "1":
+    import importlib.util as _ilu
+
+    _rw_spec = _ilu.spec_from_file_location(
+        "_racewatch_early",
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            os.pardir,
+            "k8s_operator_libs_tpu",
+            "obs",
+            "racewatch.py",
+        ),
+    )
+    _racewatch = _ilu.module_from_spec(_rw_spec)
+    _rw_spec.loader.exec_module(_racewatch)
+    _racewatch.install()
+
 import pytest
 
 from k8s_operator_libs_tpu.cluster import InformerCache, InMemoryCluster
@@ -130,3 +153,28 @@ def cache(cluster):
 @pytest.fixture()
 def recorder():
     return util.EventRecorder()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """RACEWATCH mode: the whole suite ran as one lock-order probe —
+    fail the session on any cycle (potential deadlock), with both
+    witness stacks, and print the named longest-held locks either way."""
+    if _racewatch is None or not _racewatch.installed():
+        return
+    cycles = _racewatch.lock_order_cycles()
+    rep = _racewatch.report()
+    print(
+        f"\nracewatch: {rep['sites']} lock sites, "
+        f"{len(rep['edges'])} order edges, {len(cycles)} cycle(s) "
+        f"across the suite"
+    )
+    for row in rep["locks"][:8]:
+        print(
+            f"  {row['site']:<48} hold={row['hold_ms']:.1f}ms "
+            f"max={row['hold_max_ms']:.2f}ms "
+            f"contended={row['contended']}"
+        )
+    if cycles:
+        print(_racewatch.render_report(rep))
+        print("racewatch: LOCK-ORDER CYCLE(S) DETECTED — failing the run")
+        session.exitstatus = 3

@@ -83,6 +83,20 @@ def stubbed_probes(monkeypatch):
             * 29,
         },
     )
+    monkeypatch.setattr(
+        bench,
+        "race_section",
+        lambda *a, **k: {
+            "lockcheck_findings": 9999,
+            "lockcheck_waivers": 9999,
+            "lock_order_cycles": 9999,
+            "lock_sites": 9999,
+            "top_lock_hold_ms": {
+                f"k8s_operator_libs_tpu/{'z' * 28}.py:{1000 + i}": 99999.99
+                for i in range(3)
+            },
+        },
+    )
     frame32 = "x" * 32
     monkeypatch.setattr(
         bench,
@@ -220,6 +234,11 @@ TRACKED_DETAIL_KEYS = (
     "chaos_cells_passed",
     "chaos_cells_total",
     "chaos_scenarios",
+    # the concurrency sanitizer (ISSUE 14): the static sweep must stay
+    # finding-free and the instrumented cell cycle-free — a discipline
+    # regression must be as visible per round as a speed one
+    "lockcheck_findings",
+    "lock_order_cycles",
 )
 
 

@@ -664,3 +664,114 @@ class TestPackageRelativeImports:
         )
         problems = check_paths([str(pkg)])
         assert len(problems) == 1 and "NOPE" in problems[0]
+
+
+class TestGuardAnnotationValidation:
+    """ISSUE 14 satellite: the guard annotations themselves are
+    validated — a typo'd lock name must fail lint, not silently guard
+    nothing."""
+
+    def test_valid_annotation_passes(self, tmp_path):
+        problems = run_on(
+            tmp_path,
+            """
+            import threading
+
+            class Ok:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = {}  #: guarded-by: _lock
+
+                def read(self):
+                    with self._lock:
+                        return dict(self._state)
+            """,
+        )
+        assert problems == []
+
+    def test_typod_lock_name_fails(self, tmp_path):
+        problems = run_on(
+            tmp_path,
+            """
+            import threading
+
+            class Typo:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = {}  #: guarded-by: _lokc
+
+                def read(self):
+                    with self._lock:
+                        return dict(self._state)
+            """,
+        )
+        assert any(
+            "guarded-by: _lokc" in p and "no threading.Lock" in p
+            for p in problems
+        )
+
+    def test_non_lock_attribute_named_fails(self, tmp_path):
+        problems = run_on(
+            tmp_path,
+            """
+            import threading
+
+            class NotALock:
+                def __init__(self):
+                    self._mu = 5
+                    self._state = {}  #: guarded-by: _mu
+            """,
+        )
+        assert any("no threading.Lock" in p for p in problems)
+
+    def test_dangling_annotation_fails(self, tmp_path):
+        problems = run_on(
+            tmp_path,
+            """
+            import threading
+
+            #: guarded-by: _lock
+            TOP_LEVEL = 1
+            """,
+        )
+        assert any("attaches to no" in p for p in problems)
+
+    def test_inherited_lock_resolves(self, tmp_path):
+        problems = run_on(
+            tmp_path,
+            """
+            import threading
+
+            class Base:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+            class Derived(Base):
+                def __init__(self):
+                    super().__init__()
+                    self._extra = []  #: guarded-by: _lock
+
+                def read(self):
+                    with self._lock:
+                        return list(self._extra)
+            """,
+        )
+        assert problems == []
+
+    def test_malformed_waiver_fails(self, tmp_path):
+        problems = run_on(
+            tmp_path,
+            """
+            import threading
+
+            class Bad:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def read(self):
+                    # lockcheck: unguarded-on-purpose
+                    return self._n
+            """,
+        )
+        assert any("malformed lockcheck annotation" in p for p in problems)
