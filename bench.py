@@ -1351,6 +1351,54 @@ def bench_census_memo(slices: int = 256, hosts: int = 4) -> dict:
             _, snap_off = _profiled(lambda: cycles(20))
         finally:
             set_side(True)
+
+    # ---- annotation-scan memo A/B on the SAME gated fleet (ROADMAP
+    # item 2 leftover, ISSUE 15 satellite): the pacing stamp census and
+    # the canary exposure walk ride ClusterUpgradeState.scan_memo; the
+    # bypassed side re-runs every builder per call — the pre-change
+    # per-consumer O(fleet) annotation parses.  The measured cycle is
+    # the event-driven reconciler's real gated steady state: one
+    # scheduler pass PLUS the gated branch's requeue-deadline reads
+    # (next pacing slot, canary soak) over the SAME snapshot — the
+    # repeat consumers the memo exists for.  Same interleaved
+    # paired-ratio method, same profiled frame delta.
+    from k8s_operator_libs_tpu.upgrade import schedule as schedule_mod
+    from k8s_operator_libs_tpu.upgrade.upgrade_inplace import canary_census
+
+    def annotation_cycles(n: int = 2) -> None:
+        for _ in range(n):
+            state = manager.build_state(NAMESPACE, DRIVER_LABELS)
+            manager.apply_state(state, policy)
+            schedule_mod.next_pacing_slot_at(
+                (ns.node for ns in state.all_node_states()),
+                policy.max_nodes_per_hour,
+                state=state,
+            )
+            canary_census(state, policy)
+
+    scan_get = cm.ClusterUpgradeState.scan_memo
+
+    def scan_unmemoized(self, key, builder):
+        return builder()
+
+    def set_scan(memo_on: bool) -> None:
+        cm.ClusterUpgradeState.scan_memo = (
+            scan_get if memo_on else scan_unmemoized
+        )
+
+    with tuned_gc():
+        try:
+            ann_saved_pct = overhead_mod.interleaved_overhead_pct(
+                lambda: annotation_cycles(2),
+                lambda bypassed: set_scan(not bypassed),
+                pairs=12,
+            )
+            set_scan(True)
+            _, ann_on = _profiled(lambda: annotation_cycles(20))
+            set_scan(False)
+            _, ann_off = _profiled(lambda: annotation_cycles(20))
+        finally:
+            set_scan(True)
     manager.shutdown(wait=False)
     return {
         "census_memo_speedup_1024n": round(1.0 + saved_pct / 100.0, 3),
@@ -1360,7 +1408,108 @@ def bench_census_memo(slices: int = 256, hosts: int = 4) -> dict:
             profiling_mod.merged_stacks(snap_off),
             top=5,
         ),
+        "annotation_memo_speedup_1024n": round(
+            1.0 + ann_saved_pct / 100.0, 3
+        ),
+        "profile_annotation_removed": profiling_mod.diff_collapsed(
+            profiling_mod.merged_stacks(ann_on),
+            profiling_mod.merged_stacks(ann_off),
+            top=5,
+        ),
     }
+
+
+def fed_section(fleet_per_cell: int = 6) -> dict:
+    """Fleet-of-fleets probes (federation/): a 3-cell in-mem
+    canary→region→global wave under a real FederationCoordinator.
+    Reports the cell count, the worst promotion lag (a cell's rollout
+    completing → the next cell's admission landing — the coordinator's
+    own latency, soak-free policy so the number is pure machinery), and
+    the cost of merging the per-cell persisted decision streams into
+    the one global audit trail.  ``BENCH_SKIP_FED=1`` skips."""
+    if os.environ.get("BENCH_SKIP_FED"):
+        return {"fed_cells_total": 0, "fed_skipped": True}
+    from k8s_operator_libs_tpu.api.federation_spec import (
+        FederationCellSpec,
+        FederationPolicySpec,
+    )
+    from k8s_operator_libs_tpu.federation.coordinator import (
+        Cell,
+        FederationCoordinator,
+    )
+    from k8s_operator_libs_tpu.obs import events as events_mod
+    from k8s_operator_libs_tpu.upgrade import timeline as timeline_mod
+    from k8s_operator_libs_tpu.upgrade.chaos import SimFleet, _fed_policy, _FedRig
+
+    from k8s_operator_libs_tpu import metrics
+
+    started = time.monotonic()
+    prev_registry = metrics.set_default_registry(metrics.MetricsRegistry())
+    prev_log = events_mod.set_default_log(events_mod.DecisionEventLog())
+    prev_recorder = timeline_mod.set_default_recorder(
+        timeline_mod.FlightRecorder()
+    )
+    rigs = []
+    try:
+        rigs = [
+            _FedRig(name, fleet_per_cell, _fed_policy())
+            for name in ("canary", "region", "global")
+        ]
+        spec = FederationPolicySpec(
+            name="bench",
+            target_revision="rev2",
+            cells=tuple(FederationCellSpec(name=r.name) for r in rigs),
+        )
+        coordinator = FederationCoordinator(
+            spec, [
+                Cell(
+                    name=r.name,
+                    cluster=r.store,
+                    namespace=SimFleet.NAMESPACE,
+                    selector=dict(SimFleet.LABELS),
+                    manager=r.manager,
+                    policy=r.policy,
+                    log=r.log,
+                )
+                for r in rigs
+            ],
+        )
+        status = {}
+        for _ in range(120):
+            status = coordinator.evaluate()
+            for rig in rigs:
+                rig.reconcile()
+            if status.get("promotedCells") == 3:
+                break
+        cells = {c["name"]: c for c in status.get("cells") or []}
+        lags = []
+        order = [r.name for r in rigs]
+        for prev, nxt in zip(order, order[1:]):
+            done = cells.get(prev, {}).get("completedAt")
+            admitted = cells.get(nxt, {}).get("admittedAt")
+            if done and admitted:
+                lags.append(max(0.0, float(admitted) - float(done)))
+        def merge_once() -> float:
+            t0 = time.perf_counter()
+            events_mod.merged_decisions_from_clusters(
+                {r.name: r.store for r in rigs}
+            )
+            return time.perf_counter() - t0
+
+        merge_s = min(merge_once() for _ in range(3))
+        return {
+            "fed_cells_total": status.get("cellsTotal", 0),
+            "fed_cells_promoted": status.get("promotedCells", 0),
+            "fed_promotion_lag_s": round(max(lags), 3) if lags else -1,
+            "fed_merge_ms": round(merge_s * 1000.0, 2),
+            "fed_wall_s": round(time.monotonic() - started, 2),
+        }
+    finally:
+        for rig in rigs:
+            rig.close()
+        metrics.set_default_registry(prev_registry)
+        events_mod.set_default_log(prev_log)
+        timeline_mod.set_default_recorder(prev_recorder)
 
 
 def scale_section(tuned_policy: UpgradePolicySpec) -> dict:
@@ -1727,6 +1876,10 @@ def main() -> None:
     event_driven = bench_event_driven()
     census = bench_census_memo()
 
+    # ---- fleet-of-fleets: a 3-cell federation wave under a real
+    # coordinator — cell count, promotion lag, merged-audit cost
+    fed = fed_section()
+
     # ---- differential profiling: the standing A/B pairs re-captured
     # under the sampler, so the transport/engine ratios come with the
     # slow side's top self-time frames attached (obs/profiling.py)
@@ -1799,6 +1952,7 @@ def main() -> None:
                     **race,
                     **event_driven,
                     **census,
+                    **fed,
                     "engine": {
                         "speedup_full_vs_all_off": round(
                             engine_all_off_s / engine_full_s, 3
@@ -1896,6 +2050,13 @@ COMPACT_SHED_FIRST = (
     "top_lock_hold_ms",
     "lock_sites",
     "lockcheck_waivers",
+    "profile_annotation_removed",
+    "fed_wall_s",
+    "fed_cells_promoted",
+    # derivable twins: the speedup ratios already track these pairs
+    "build_state_full_ms_4096n",
+    "rollback_trip_s_1024n",
+    "slo_eval_ms_1024n",
     "profile_pair_walls_s",
     "profile_inmem_top",
     "profile_idle_poll_top",

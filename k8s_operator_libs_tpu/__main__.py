@@ -59,6 +59,16 @@ Subcommands:
       python -m k8s_operator_libs_tpu chaos --campaign nightly.json
       python -m k8s_operator_libs_tpu chaos --selftest   # make verify-chaos
 
+* ``fedstatus`` — the fleet-of-fleets federation plane
+  (:mod:`.federation`): cell phases (canary cluster → region → global),
+  the global breaker, the ETA rollup, "why is cell Y not promoting",
+  and the merged cross-cluster audit trail.
+
+      python -m k8s_operator_libs_tpu fedstatus --url http://127.0.0.1:8080
+      python -m k8s_operator_libs_tpu fedstatus --spec fed.json \\
+          --cell canary=a.json --cell region=b.json --explain region
+      python -m k8s_operator_libs_tpu fedstatus --selftest   # make verify-federation
+
 * ``profile`` — the continuous profiling plane (:mod:`.obs.profiling`):
   live-capture a window from the operator's ``/debug/profile``
   endpoint, render a saved dump (span self-time table + top frames,
@@ -847,6 +857,146 @@ def cmd_events(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_fedstatus(args: argparse.Namespace) -> int:
+    """Fleet-of-fleets federation status (:mod:`.federation`): cell
+    phases, the global breaker, the ETA rollup, the per-cell explain,
+    and the merged cross-cluster audit trail — live from a running
+    coordinator's ``/debug/federation`` or offline from per-cell dumps
+    plus the federation policy.  ``--selftest`` runs the 3-cell
+    canary→region→global e2e over real HTTP (the
+    ``make verify-federation`` gate)."""
+    from .federation import selftest as fed_selftest_mod
+    from .federation.coordinator import (
+        explain_cell,
+        federation_report_from_clusters,
+        render_cell_explanation,
+        render_federation_report,
+    )
+    from .obs import events as events_mod
+
+    if args.selftest:
+        try:
+            print(fed_selftest_mod.selftest())
+        except AssertionError as err:
+            print(f"federation selftest FAILED: {err}", file=sys.stderr)
+            return 1
+        return 0
+    util.set_component_name(args.component)
+
+    if args.url:
+        # live: the coordinator's ops server answers everything
+        import urllib.error
+        import urllib.request
+
+        base = args.url.rstrip("/") + "/debug/federation"
+        if args.explain:
+            base += f"?cell={args.explain}"
+        elif args.events:
+            base += "?events=1"
+        try:
+            with urllib.request.urlopen(base, timeout=10) as rsp:
+                payload = json.loads(rsp.read())
+        except urllib.error.HTTPError as err:
+            # the server ANSWERED — do not misreport it as unreachable:
+            # 404 means an unknown cell (--explain typo) or a server
+            # without a federation source, mirroring the offline path's
+            # unknown-cell exit 3
+            body = ""
+            try:
+                body = err.read().decode(errors="replace").strip()
+            except OSError:
+                pass
+            print(body or f"{base}: HTTP {err.code}", file=sys.stderr)
+            return 3 if err.code == 404 else 2
+        except (OSError, ValueError, urllib.error.URLError) as err:
+            print(f"cannot reach {base}: {err}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(payload))
+            return 0
+        if args.explain:
+            print(render_cell_explanation(payload))
+            return 0
+        report = payload.get("report") if "report" in payload else payload
+        if report is None:
+            print("coordinator has not evaluated yet", file=sys.stderr)
+            return 3
+        print(render_federation_report(report))
+        if args.events:
+            for d in payload.get("events") or []:
+                print("  " + events_mod.format_decision_line(d))
+        breaker = (report or {}).get("breaker") or {}
+        return 3 if (args.wait_exit_code and breaker.get("state") == "open")\
+            else 0
+
+    # offline: per-cell dumps + the federation policy JSON
+    if not args.spec or not args.cell:
+        print(
+            "fedstatus needs --url (live), or --spec fed.json with one "
+            "--cell name=dump.json per cell (offline), or --selftest",
+            file=sys.stderr,
+        )
+        return 2
+    from .api.federation_spec import FederationPolicySpec
+    from .api.upgrade_spec import ValidationError
+    from .cluster.inmem import InMemoryCluster
+
+    try:
+        with open(args.spec) as fh:
+            spec = FederationPolicySpec.from_dict(json.load(fh))
+        spec.validate()
+    except (OSError, ValueError, ValidationError) as err:
+        print(f"cannot load federation spec {args.spec}: {err}",
+              file=sys.stderr)
+        return 2
+    clusters = {}
+    for item in args.cell:
+        name, _, path = item.partition("=")
+        if not name or not path:
+            print(
+                f"--cell wants name=dump.json, got {item!r}", file=sys.stderr
+            )
+            return 2
+        try:
+            with open(path) as fh:
+                clusters[name] = InMemoryCluster.from_dict(json.load(fh))
+        except (OSError, ValueError) as err:
+            print(f"cannot load cell dump {path}: {err}", file=sys.stderr)
+            return 2
+    try:
+        report = federation_report_from_clusters(
+            spec,
+            clusters,
+            args.namespace,
+            _parse_selector_arg(args.selector),
+        )
+    except ValueError as err:
+        print(str(err), file=sys.stderr)
+        return 2
+    merged = events_mod.merged_decisions_from_clusters(clusters)
+    if args.explain:
+        answer = explain_cell(args.explain, report, merged)
+        if answer is None:
+            print(f"unknown cell {args.explain!r}", file=sys.stderr)
+            return 3
+        print(json.dumps(answer) if args.json
+              else render_cell_explanation(answer))
+        return 0
+    if args.json:
+        out = dict(report)
+        if args.events:
+            out["events"] = merged
+        print(json.dumps(out))
+        return 0
+    print(render_federation_report(report))
+    if args.events:
+        for d in merged:
+            print("  " + events_mod.format_decision_line(d))
+    breaker = report.get("breaker") or {}
+    return 3 if (args.wait_exit_code and breaker.get("state") == "open") \
+        else 0
+
+
 def cmd_chaos(args: argparse.Namespace) -> int:
     """The chaos campaign engine (upgrade/chaos.py): run a declarative
     fault-scenario sweep and print the resilience scorecard.  Exit 0
@@ -1538,6 +1688,60 @@ def main(argv=None) -> int:
         help="same end-to-end smoke as `explain --selftest`",
     )
     ev.set_defaults(func=cmd_events)
+
+    fd = sub.add_parser(
+        "fedstatus",
+        help="fleet-of-fleets federation status (federation/): cell "
+        "phases, the global breaker, the ETA rollup, per-cell explain "
+        "and the merged cross-cluster audit trail — live from a "
+        "coordinator's /debug/federation or offline from per-cell "
+        "dumps; --selftest runs the 3-cell e2e over real HTTP",
+    )
+    _add_query_args(fd)
+    fd.add_argument("--json", action="store_true", help="machine output")
+    fd.add_argument(
+        "--url",
+        default="",
+        help="live mode: the coordinator ops server base URL "
+        "(e.g. http://127.0.0.1:8080)",
+    )
+    fd.add_argument(
+        "--spec",
+        default="",
+        help="offline mode: FederationPolicySpec JSON file",
+    )
+    fd.add_argument(
+        "--cell",
+        action="append",
+        default=[],
+        metavar="NAME=DUMP.json",
+        help="offline mode: one per cell — the cell's cluster dump",
+    )
+    fd.add_argument(
+        "--explain",
+        default="",
+        metavar="CELL",
+        help="answer 'why is cell CELL not promoting'",
+    )
+    fd.add_argument(
+        "--events",
+        action="store_true",
+        help="include the merged cross-cluster decision stream",
+    )
+    fd.add_argument(
+        "--wait-exit-code",
+        action="store_true",
+        help="exit 3 while the global breaker is open (poll-friendly)",
+    )
+    fd.add_argument(
+        "--selftest",
+        action="store_true",
+        help="3-cell canary→region→global e2e over real HTTP: healthy "
+        "wave promotes in order, injected cell breach trips the global "
+        "breaker, holds the wave and rolls back to the LKG "
+        "(make verify-federation)",
+    )
+    fd.set_defaults(func=cmd_fedstatus)
 
     ch = sub.add_parser(
         "chaos",

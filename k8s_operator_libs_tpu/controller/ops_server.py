@@ -39,6 +39,12 @@ server exposing
   states, condition values with held-for windows, exposure cap, AIMD
   pacing scale) when an *analysis_source* was wired (usually
   ``manager.analysis_status``); 404 otherwise;
+* ``GET /debug/federation`` — the fleet-of-fleets coordinator's latest
+  status (cell phases, the global breaker, the ETA rollup) when a
+  *federation_source* was wired (usually ``coordinator.status``); 404
+  otherwise; ``?cell=<name>`` answers "why is cell Y not promoting"
+  (the federated explain), ``?events=1`` inlines the merged
+  cross-cluster decision stream;
 * ``GET /debug/timeline`` — the flight recorder's per-node phase
   timelines when a *timeline_source* was wired (usually
   ``manager.timeline_status``); ``?node=<name>`` filters to one node
@@ -119,6 +125,11 @@ class OpsServer:
         explain_source: Optional[Callable[[str], Optional[dict]]] = None,
         analysis_source: Optional[Callable[[], Optional[dict]]] = None,
         slo_history_source: Optional[Callable[[], Optional[dict]]] = None,
+        federation_source: Optional[Callable[[], Optional[dict]]] = None,
+        federation_explain_source: Optional[
+            Callable[[str], Optional[dict]]
+        ] = None,
+        federation_events_source: Optional[Callable[[], list]] = None,
     ) -> None:
         # All-interfaces default, like controller-runtime's metrics/probe
         # listeners: kubelet probes and Prometheus scrapes arrive on the
@@ -177,6 +188,15 @@ class OpsServer:
         #: Callable returning the SLO metrics-history ring's snapshot;
         #: served inline by /debug/slo?history=1 when wired.
         self._slo_history_source = slo_history_source
+        #: Federation plane (federation/coordinator.py): the fleet-of-
+        #: fleets status report (usually ``coordinator.status``), the
+        #: per-cell explain (``coordinator.explain_cell`` — served for
+        #: ``?cell=<name>``), and the merged cross-cluster decision
+        #: stream (``coordinator.merged_decisions`` — ``?events=1``).
+        #: Absent means /debug/federation 404s.
+        self._federation_source = federation_source
+        self._federation_explain_source = federation_explain_source
+        self._federation_events_source = federation_events_source
         # THE debug route registry: path -> handler(query).  The /debug
         # index is DERIVED from this dict, so a wired endpoint can never
         # be missing from it (the index used to be maintained by hand —
@@ -201,6 +221,8 @@ class OpsServer:
             self._debug_routes["/debug/explain"] = self._render_explain
         if analysis_source is not None:
             self._debug_routes["/debug/analysis"] = self._render_analysis
+        if federation_source is not None:
+            self._debug_routes["/debug/federation"] = self._render_federation
         self._health_checks: Dict[str, Check] = {}
         self._ready_checks: Dict[str, Check] = {}
         self._lock = threading.Lock()
@@ -364,6 +386,49 @@ class OpsServer:
         self, _query: Dict[str, list]
     ) -> Tuple[int, str, bytes]:
         payload = {"configured": True, "report": self._analysis_source()}
+        return (
+            200,
+            "application/json",
+            (json.dumps(payload) + "\n").encode(),
+        )
+
+    def _render_federation(
+        self, query: Dict[str, list]
+    ) -> Tuple[int, str, bytes]:
+        cell = (query.get("cell") or [""])[0]
+        if cell:
+            if self._federation_explain_source is None:
+                return (
+                    404,
+                    "text/plain; charset=utf-8",
+                    b"no federation explain source wired\n",
+                )
+            answer = self._federation_explain_source(cell)
+            if answer is None:
+                return (
+                    404,
+                    "text/plain; charset=utf-8",
+                    f"no explanation for cell {cell} (unknown cell, or no "
+                    f"coordinator tick yet)\n".encode(),
+                )
+            return (
+                200,
+                "application/json",
+                (json.dumps(answer) + "\n").encode(),
+            )
+        payload: dict = {
+            "configured": True,
+            "report": self._federation_source(),
+        }
+        if (query.get("events") or [""])[0] in ("1", "true"):
+            # the merged cross-cluster audit trail (timestamp-first/
+            # seq-tiebreak over every cell's persisted decision stream
+            # plus the coordinator's own)
+            payload["events"] = (
+                self._federation_events_source()
+                if self._federation_events_source is not None
+                else None
+            )
         return (
             200,
             "application/json",

@@ -174,7 +174,9 @@ class UpgradeReconciler:
         limit = policy.max_nodes_per_hour or 0
         if limit > 0:
             slot_at = schedule.next_pacing_slot_at(
-                (ns.node for ns in state.all_node_states()), limit
+                (ns.node for ns in state.all_node_states()),
+                limit,
+                state=state,
             )
             if slot_at is not None:
                 deadlines.append(slot_at - now)

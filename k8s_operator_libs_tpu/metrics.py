@@ -637,6 +637,84 @@ def record_node_quarantine() -> None:
     ).inc()
 
 
+# ---- federation (fleet-of-fleets) ----------------------------------------
+#: Cell phase encoding for the federation_cell_phase gauge (documented
+#: in docs/federation.md; the coordinator and dashboards share it).
+FEDERATION_PHASE_CODES = {
+    "pending": 0,
+    "rolling": 1,
+    "soaking": 2,
+    "promoted": 3,
+    "held": 4,
+    "breached": 5,
+    "unreachable": 6,
+    # ordinary wave-order waiting — NOT counted into
+    # federation_cells_held (a healthy multi-hour wave always has
+    # queued cells; only abnormal holds should page)
+    "queued": 7,
+}
+
+
+def publish_federation_gauges(
+    cells_total: int,
+    cells_held: int,
+    breaker_open: bool,
+    eta_seconds: float,
+    phases,
+) -> None:
+    """Federation-coordinator state: cell count, cells currently held
+    (admission blocked by order/conditions or the global breaker), the
+    global breaker position, the fleet-of-fleets ETA rollup (-1 =
+    unknown), and each cell's phase (see
+    :data:`FEDERATION_PHASE_CODES`)."""
+    reg = default_registry()
+    reg.gauge(
+        "federation_cells_total",
+        "Cells (clusters) declared by the federation policy.",
+    ).set(cells_total)
+    reg.gauge(
+        "federation_cells_held",
+        "Cells abnormally held (global breaker / breached / "
+        "unreachable) — ordinary wave-order queueing not counted.",
+    ).set(cells_held)
+    reg.gauge(
+        "federation_breaker_state",
+        "Global federation breaker position (0 closed, 1 open).",
+    ).set(1 if breaker_open else 0)
+    reg.gauge(
+        "federation_global_eta_seconds",
+        "Projected seconds until the whole cell wave completes "
+        "(-1 = unknown).",
+    ).set(eta_seconds)
+    reg.gauge(
+        "federation_cell_phase",
+        "Per-cell wave phase (0 pending, 1 rolling, 2 soaking, "
+        "3 promoted, 4 held, 5 breached, 6 unreachable, 7 queued).",
+        ("cell",),
+    ).replace(
+        {
+            (cell,): float(FEDERATION_PHASE_CODES.get(phase, 0))
+            for cell, phase in (phases or {}).items()
+        }
+    )
+
+
+def record_federation_trip() -> None:
+    """The global federation breaker tripped (cell admissions paused)."""
+    default_registry().counter(
+        "federation_breaker_trips_total",
+        "Global federation breaker trips.",
+    ).inc()
+
+
+def record_cell_promotion() -> None:
+    """A cell completed, soaked, and promoted (next cell may admit)."""
+    default_registry().counter(
+        "federation_promotions_total",
+        "Federation cell promotions.",
+    ).inc()
+
+
 def _slo_gauge_families() -> tuple:
     """The five SLO gauge families, shared by publish and retire so
     their definitions exist exactly once: (phase_seconds, eta,

@@ -83,6 +83,11 @@ EVENT_SLO_BREACHED = "SloBreached"
 EVENT_ANALYSIS_STEP_ADVANCED = "AnalysisStepAdvanced"
 EVENT_ANALYSIS_ABORTED = "AnalysisAborted"
 EVENT_PACING_ADAPTED = "PacingAdapted"
+# -- federation plane (one coordinator, N clusters; see
+# :mod:`..federation`): whole CELLS are the admission unit.
+EVENT_CELL_ADMITTED = "CellAdmitted"
+EVENT_CELL_PROMOTED = "CellPromoted"
+EVENT_CELL_HELD = "CellHeld"
 
 #: Reason codes (machine-readable; the full table lives in
 #: docs/observability.md and must stay in sync with it).
@@ -99,6 +104,10 @@ REASON_SLICE_DOMAIN = "slice-domain"    # NodeDeferred: domain can never fit pac
 REASON_ROLLBACK_OVERTOOK = "rollback-overtook"  # NodeUnadmitted
 REASON_SLO_GATE = "gate:slo"            # NodeDeferred/Analysis*: analysis gate
 REASON_PACING_ADAPT = "pacing:adapt"    # PacingAdapted: AIMD scale change
+REASON_CELL_PROMOTE = "cell:promote"    # CellAdmitted/CellPromoted: wave order
+REASON_CELL_HOLD = "cell:hold"          # CellHeld: rollout order / conditions
+REASON_FEDERATION_GATE = "gate:federation"  # CellHeld: global breaker open
+REASON_FEDERATION = "federation"        # BreakerTripped: global budget rollup
 
 #: Fleet-level events (no single node) carry this target.
 FLEET_TARGET = "fleet"
@@ -143,12 +152,17 @@ EVENT_REASONS: Dict[str, Optional[frozenset]] = {
     EVENT_NODE_RETRIED: frozenset({"resync", "pod-replace"}),
     EVENT_NODE_QUARANTINED: frozenset({"retry-budget"}),
     EVENT_QUARANTINE_RELEASED: frozenset({"repaired"}),
-    EVENT_BREAKER_TRIPPED: frozenset({"failure-budget", "slo"}),
+    EVENT_BREAKER_TRIPPED: frozenset(
+        {"failure-budget", "slo", REASON_FEDERATION}
+    ),
     EVENT_ROLLBACK_STARTED: frozenset({"breaker"}),
     EVENT_SLO_BREACHED: None,  # reason = the declared SLO's name
     EVENT_ANALYSIS_STEP_ADVANCED: frozenset({REASON_SLO_GATE}),
     EVENT_ANALYSIS_ABORTED: frozenset({REASON_SLO_GATE}),
     EVENT_PACING_ADAPTED: frozenset({REASON_PACING_ADAPT}),
+    EVENT_CELL_ADMITTED: frozenset({REASON_CELL_PROMOTE}),
+    EVENT_CELL_PROMOTED: frozenset({REASON_CELL_PROMOTE}),
+    EVENT_CELL_HELD: frozenset({REASON_CELL_HOLD, REASON_FEDERATION_GATE}),
 }
 
 #: Default bound on retained (deduplicated) decision entries.
@@ -890,6 +904,9 @@ _KNOWN_TYPES = frozenset(
         EVENT_ANALYSIS_STEP_ADVANCED,
         EVENT_ANALYSIS_ABORTED,
         EVENT_PACING_ADAPTED,
+        EVENT_CELL_ADMITTED,
+        EVENT_CELL_PROMOTED,
+        EVENT_CELL_HELD,
     )
 )
 
@@ -947,6 +964,10 @@ def decisions_from_cluster(
                 "firstTimestamp": ev.get("firstTimestamp") or "",
                 "lastTimestamp": ev.get("lastTimestamp") or "",
                 "traceId": None,
+                # the LOG INSTANCE whose sink last wrote this Event —
+                # lets a live merge recognize (and keep exactly one
+                # copy of) its OWN persisted decisions
+                "src": annotations.get(SRC_ANNOTATION) or "",
             }
         )
     # Timestamp first, sequence as the SUB-second tiebreaker: the seq
@@ -960,15 +981,107 @@ def decisions_from_cluster(
     return out
 
 
+def _merge_sort_key(decision: dict) -> tuple:
+    """THE cross-stream ordering: timestamp first (ISO strings — or the
+    live log's float epoch stamps rendered to a sortable form — order
+    correctly across processes and clusters), per-process sequence as
+    the sub-second tiebreaker, then (cell, type, target) so two streams
+    merged in any input order produce byte-identical output.  The same
+    rule :func:`decisions_from_cluster` applies within one cluster,
+    promoted here to the federation merge."""
+    ts = decision.get("lastTimestamp")
+    if isinstance(ts, (int, float)):
+        # live-log epoch floats and persisted ISO strings may meet in
+        # one merge (live view vs offline reconstruction): render the
+        # float the way the sink's _iso does, at whole-second
+        # resolution, so the two spellings of the same instant compare
+        # equal and the seq tiebreaker decides
+        ts = ClusterDecisionEventSink._iso(float(ts))
+    return (
+        str(ts or ""),
+        int(decision.get("seq") or 0),
+        str(decision.get("cell") or ""),
+        str(decision.get("type") or ""),
+        str(decision.get("target") or ""),
+    )
+
+
+def merge_cell_streams(streams) -> List[dict]:
+    """Merge per-cluster decision streams into ONE globally ordered
+    audit trail (the federation plane's merged view).
+
+    *streams* maps cell name -> decision list (each as served by
+    :meth:`DecisionEventLog.events`/``snapshot`` or reconstructed by
+    :func:`decisions_from_cluster`); iterables of ``(cell, decisions)``
+    pairs are accepted too.  Every output decision is tagged with its
+    source ``cell``.  Guarantees (property-tested in
+    tests/test_federation.py):
+
+    * **order-stable** — output is a pure function of the decision SET,
+      independent of input stream order (timestamp-first, seq-tiebreak,
+      then cell/type/target: the cross-process rule
+      :func:`decisions_from_cluster` already applies within one
+      cluster, so per-cell restarts and skewed clocks order exactly as
+      they do in the single-cluster offline view);
+    * **lossless** — every input decision appears exactly once; feeding
+      the same cell's stream twice (a duplicate adoption — e.g. the
+      live log AND its own persisted reconstruction) dedups on the
+      decision's identity, never double-counts.
+    """
+    if isinstance(streams, dict):
+        pairs = streams.items()
+    else:
+        pairs = streams
+    merged: List[dict] = []
+    seen = set()
+    for cell, decisions in sorted(pairs, key=lambda p: str(p[0])):
+        for d in decisions or []:
+            tagged = dict(d, cell=str(cell))
+            identity = (
+                tagged["cell"],
+                str(tagged.get("type") or ""),
+                str(tagged.get("reason") or ""),
+                str(tagged.get("target") or ""),
+                int(tagged.get("seq") or 0),
+            )
+            if identity in seen:
+                continue
+            seen.add(identity)
+            merged.append(tagged)
+    merged.sort(key=_merge_sort_key)
+    return merged
+
+
+def merged_decisions_from_clusters(
+    clusters, namespace: Optional[str] = None, strict: bool = False
+) -> List[dict]:
+    """The offline federated audit trail: reconstruct each cell's
+    persisted decision Events and merge them
+    (:func:`merge_cell_streams`).  *clusters* maps cell name ->
+    ClusterClient."""
+    return merge_cell_streams(
+        {
+            cell: decisions_from_cluster(
+                cluster, namespace=namespace, strict=strict
+            )
+            for cell, cluster in clusters.items()
+        }
+    )
+
+
 def format_decision_line(decision: dict) -> str:
     """THE one-line rendering of a decision dict —
     ``Type[reason] target ×count — message`` — shared by the ``events``
     CLI, ``rollout_status``'s last-decisions block and ``explain``'s
     recent-decisions list, so the three surfaces can never drift apart
     on the same decision."""
+    target = decision.get("target", "")
+    if decision.get("cell"):
+        # a federation-merged decision names its source cluster
+        target = f"{decision['cell']}/{target}"
     line = (
         f"{decision.get('type', '?')}[{decision.get('reason', '?')}] "
-        f"{decision.get('target', '')}"
+        f"{target}"
     ).rstrip()
     count = int(decision.get("count") or 1)
     if count > 1:

@@ -201,6 +201,10 @@ INVARIANTS = (
     # not an invariant over cluster state but part of the violation
     # vocabulary: the scenario's fault demonstrably never fired
     "evidence",
+    # federated scenarios: the cell-wave safety property — no
+    # un-admitted cell admits a node while the wave is held (global
+    # breaker open, unreachable cell, or unpromoted predecessor)
+    "federation-wave",
 )
 
 
@@ -887,6 +891,12 @@ class Scenario:
     #: wrap the in-mem store in a CrashingClient (inmem cells)
     crashing: bool = False
     max_cycles: int = 150
+    #: Scenario-owned cell runner: fn(scenario, transport, gates,
+    #: fleet_size, seed, driver) -> scorecard row.  Scenarios whose
+    #: harness is NOT the single-cluster CampaignCell (the federated
+    #: fleet-of-fleets scenarios spin up a 3-cell coordinator rig)
+    #: plug in here; run_cell dispatches before building anything.
+    runner: Optional[Callable] = None
 
 
 def _setup_brownout(cell) -> None:
@@ -1050,6 +1060,412 @@ def _tick_gc_race(cell, cycle: int) -> None:
 
 def _setup_bad_revision(cell) -> None:
     cell.fleet.bad_revisions.add("rev2")
+
+
+# --------------------------------------------------------------------------
+# Federated scenarios (ROADMAP item 5 leftover: plug the federation
+# subsystem in as campaign cells).  These run their OWN harness — a
+# 3-cell in-mem fleet-of-fleets under a real FederationCoordinator —
+# via the Scenario.runner hook, and are judged by the same per-cell
+# rollout-invariant checker PLUS the cell-wave property: no un-admitted
+# cell admits a node while the wave is held.
+# --------------------------------------------------------------------------
+class _OutageClient:
+    """Cluster-client proxy that, while armed, answers every call with
+    a connection error — the coordinator's view of a dead cell
+    apiserver.  Counts refusals as the scenario's evidence."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.down = False
+        self.refused = 0
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if not callable(attr):
+            return attr
+
+        def wrapped(*args, **kwargs):
+            if self.down:
+                self.refused += 1
+                raise OSError("cell apiserver down (chaos outage)")
+            return attr(*args, **kwargs)
+
+        return wrapped
+
+
+class _FedRig:
+    """One in-mem federation cell for the chaos runner: store + fleet
+    sim + manager + per-cell decision log/sink."""
+
+    def __init__(self, name: str, fleet_size: int, policy) -> None:
+        self.name = name
+        self.store = InMemoryCluster()
+        self.store._journal_cap = 500_000
+        self.fleet = SimFleet(self.store, fleet_size)
+        self.log = events_mod.DecisionEventLog()
+        self.sink = events_mod.ClusterDecisionEventSink(
+            self.store, namespace="default"
+        )
+        self.policy = policy
+        from ..cluster.cache import InformerCache
+
+        self.manager = ClusterUpgradeStateManager(
+            self.store,
+            cache=InformerCache(self.store, lag_seconds=0.0),
+            cache_sync_timeout_seconds=2.0,
+            cache_sync_poll_seconds=0.005,
+            decision_event_sink=self.sink,
+        )
+        self.tape = AuditTape(self.store, policy)
+
+    def reconcile(self) -> None:
+        previous = events_mod.set_default_log(self.log)
+        try:
+            state = self.manager.build_state(
+                SimFleet.NAMESPACE, SimFleet.LABELS
+            )
+            self.manager.apply_state(state, self.policy)
+            self.manager.drain_manager.wait_idle(10.0)
+            self.manager.pod_manager.wait_idle(10.0)
+        except (ApiError, OSError, UpgradeStateError):
+            pass
+        finally:
+            events_mod.set_default_log(previous)
+        try:
+            self.fleet.reconcile()
+        except (ApiError, OSError):
+            pass
+        self.tape.collect()
+
+    def close(self) -> None:
+        self.manager.shutdown()
+
+
+def _fed_policy() -> UpgradePolicySpec:
+    from ..api.upgrade_spec import SloSpec
+
+    return UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=2,
+        max_unavailable=IntOrString("50%"),
+        drain_spec=DrainSpec(enable=True, force=True, timeout_second=10),
+        # lax local breaker: the federated scenarios exercise the
+        # COORDINATOR's rollup, not the per-cluster trip
+        remediation=RemediationSpec(
+            failure_threshold=0.95,
+            min_attempted=1000,
+            auto_rollback=True,
+            backoff_seconds=0.0,
+        ),
+        slos=SloSpec(fleet_completion_deadline_seconds=86400),
+    )
+
+
+def _run_federated_cell(
+    scenario: Scenario,
+    transport: str,
+    gates: str,
+    fleet_size: int,
+    seed: int,
+    driver: str = "polling",
+) -> dict:
+    """Scenario.runner for the federated cells: a 3-cell in-mem
+    fleet-of-fleets wave under a real coordinator, with the scenario's
+    fault injected mid-global-wave.  Judged by the per-cell rollout
+    invariants, the decision vocabulary over BOTH planes (cells + the
+    coordinator's stream), the cell-wave hold property, and the
+    scenario's evidence probe."""
+    from ..api.federation_spec import (
+        FederationCellSpec,
+        FederationPolicySpec,
+    )
+    from ..federation.coordinator import Cell, FederationCoordinator
+
+    started = time.monotonic()
+    rng = random.Random(seed)
+    per_cell = max(2, fleet_size // 2)
+    prev_registry = metrics.set_default_registry(metrics.MetricsRegistry())
+    prev_log = events_mod.set_default_log(events_mod.DecisionEventLog())
+    prev_recorder = timeline_mod.set_default_recorder(
+        timeline_mod.FlightRecorder()
+    )
+    violations: List[Violation] = []
+    notes: Dict[str, object] = {}
+    rigs: List[_FedRig] = []
+    cycles = 0
+    converged = False
+    try:
+        brownout = scenario.name == "federated-cell-brownout"
+        rigs = [
+            _FedRig("canary", per_cell, _fed_policy()),
+            _FedRig("region", per_cell, _fed_policy()),
+            _FedRig("global", per_cell, _fed_policy()),
+        ]
+        region, global_rig = rigs[1], rigs[2]
+        outage = _OutageClient(region.store)
+        burn = {"rate": 0.2}
+
+        def region_slo() -> dict:
+            # the forged SLO surface the brownout condition reads; the
+            # failover scenario leaves it healthy throughout
+            return {
+                "slos": {
+                    "burnRates": {
+                        "fleetCompletionDeadlineSeconds": burn["rate"]
+                    },
+                    "breaches": [],
+                },
+                "stragglers": [],
+                "eta": None,
+            }
+
+        cells = []
+        for rig in rigs:
+            cells.append(
+                Cell(
+                    name=rig.name,
+                    cluster=(
+                        outage if rig is region else rig.store
+                    ),
+                    namespace=SimFleet.NAMESPACE,
+                    selector=dict(SimFleet.LABELS),
+                    manager=rig.manager,
+                    policy=rig.policy,
+                    log=rig.log,
+                    slo_source=region_slo if rig is region else None,
+                )
+            )
+        spec = FederationPolicySpec(
+            name=scenario.name,
+            target_revision="rev2",
+            cells=(
+                FederationCellSpec(name="canary"),
+                FederationCellSpec(
+                    name="region",
+                    advance_on=(
+                        ("burn:fleetCompletionDeadlineSeconds < 1.0",)
+                        if brownout
+                        else ()
+                    ),
+                ),
+                FederationCellSpec(name="global"),
+            ),
+        )
+        coordinator = FederationCoordinator(spec, cells)
+
+        fault_window = 0
+        status: dict = {}
+        for cycle in range(scenario.max_cycles):
+            cycles = cycle + 1
+            status = coordinator.evaluate()
+            phases = {c["name"]: c["phase"] for c in status["cells"]}
+            admitted = {
+                c["name"]: bool(c.get("admittedAt"))
+                for c in status["cells"]
+            }
+            if brownout:
+                # arm the burn the moment the region is ADMITTED (its
+                # samples then read breached before completion can
+                # promote it): the completed-but-burning cell must hold
+                # in soaking, healthy cells unaffected
+                if phases.get("region") == PHASE_ROLLING_FED and (
+                    fault_window == 0
+                ):
+                    burn["rate"] = 5.0
+                    fault_window = 1
+                    notes["burn_armed_at"] = cycle
+                elif fault_window and burn["rate"] > 1.0:
+                    if phases.get("region") == "soaking":
+                        # completed, held on the breached condition
+                        notes["held_ticks"] = (
+                            int(notes.get("held_ticks", 0)) + 1
+                        )
+                        if admitted["global"]:
+                            violations.append(
+                                Violation(
+                                    "federation-wave",
+                                    "global cell admitted while the "
+                                    "region's SLO burn held its "
+                                    "promotion",
+                                )
+                            )
+                        if phases.get("canary") != "promoted":
+                            violations.append(
+                                Violation(
+                                    "federation-wave",
+                                    "healthy canary cell disturbed by "
+                                    f"the region brownout ({phases})",
+                                )
+                            )
+                        if int(notes.get("held_ticks", 0)) >= 5:
+                            burn["rate"] = 0.2  # brownout clears
+                            notes["burn_cleared_at"] = cycle
+            else:
+                # failover: the region's apiserver dies mid-wave (while
+                # it is rolling), for a few coordinator ticks
+                if (
+                    phases.get("region") == PHASE_ROLLING_FED
+                    and fault_window == 0
+                ):
+                    outage.down = True
+                    fault_window = 1
+                    notes["outage_at"] = cycle
+                elif fault_window and fault_window < 4:
+                    fault_window += 1
+                    if admitted["global"]:
+                        violations.append(
+                            Violation(
+                                "federation-wave",
+                                "global cell admitted while the region "
+                                "cell's apiserver was down",
+                            )
+                        )
+                elif fault_window >= 4 and outage.down:
+                    outage.down = False
+                    notes["outage_cleared_at"] = cycle
+            for rig in rigs:
+                if rig is region and outage.down:
+                    # a dead apiserver means its operator cannot
+                    # reconcile either
+                    notes["region_skipped"] = (
+                        int(notes.get("region_skipped", 0)) + 1
+                    )
+                    continue
+                rig.reconcile()
+            if status.get("promotedCells") == 3:
+                converged = True
+                break
+        # settle one final census so the row reflects the end state
+        status = coordinator.evaluate()
+        converged = converged or status.get("promotedCells") == 3
+
+        # ---- evidence: the fault demonstrably fired AND the hold was
+        # audited with the new reason codes
+        coord_stream = coordinator.log.export_stream()
+        held_targets = {
+            d["target"]
+            for d in coord_stream
+            if d["type"] == events_mod.EVENT_CELL_HELD
+        }
+        if brownout:
+            if not notes.get("held_ticks"):
+                violations.append(
+                    Violation(
+                        "evidence",
+                        "the region's SLO burn never demonstrably held "
+                        "its promotion",
+                    )
+                )
+        else:
+            if outage.refused < 1:
+                violations.append(
+                    Violation(
+                        "evidence",
+                        "the region outage never refused a coordinator "
+                        "request",
+                    )
+                )
+        if "cell:global" not in held_targets:
+            violations.append(
+                Violation(
+                    "evidence",
+                    "no CellHeld decision for the global cell — the "
+                    "hold left no audit trail",
+                )
+            )
+        if not converged:
+            violations.append(
+                Violation(
+                    "converged",
+                    "the wave did not complete after the fault cleared: "
+                    + str(
+                        {c["name"]: c["phase"] for c in status["cells"]}
+                    ),
+                )
+            )
+
+        # ---- decision vocabulary over the coordinator's stream (the
+        # new cell:* / gate:federation reasons must be REGISTERED)
+        for d in coord_stream:
+            type_ = d.get("type") or ""
+            legal = events_mod.EVENT_REASONS.get(type_)
+            if type_ not in events_mod.EVENT_REASONS:
+                violations.append(
+                    Violation(
+                        "decision-vocabulary",
+                        f"coordinator emitted unknown type {type_!r}",
+                    )
+                )
+            elif legal is not None and (d.get("reason") or "") not in legal:
+                violations.append(
+                    Violation(
+                        "decision-vocabulary",
+                        f"coordinator {type_} carries unregistered "
+                        f"reason {d.get('reason')!r}",
+                    )
+                )
+
+        # ---- the standard per-cell rollout invariants (each cell is a
+        # normal single-cluster rollout underneath)
+        decisions_total = len(coord_stream)
+        for rig in rigs:
+            decisions = rig.log.export_stream()
+            decisions_total += len(decisions)
+            persisted = events_mod.decisions_from_cluster(rig.store)
+            cell_violations = check_rollout_invariants(
+                rig.store,
+                managed_nodes=rig.fleet.managed_nodes,
+                policy=rig.policy,
+                decisions=decisions,
+                tape=rig.tape,
+                persisted_decisions=persisted,
+                ds_name=SimFleet.DS_NAME,
+                ds_namespace=SimFleet.NAMESPACE,
+                target_revision="rev2",
+                # wave-level non-convergence is already reported once
+                # above; None skips the per-cell pile-on
+                converged=(
+                    rig.fleet.converged("rev2", reader=rig.store)
+                    if converged
+                    else None
+                ),
+                expect=scenario.expect,
+            )
+            for v in cell_violations:
+                violations.append(
+                    Violation(v.invariant, f"[cell {rig.name}] {v.detail}")
+                )
+        # rng is part of the seed contract even though these scenarios
+        # are deterministic by construction today
+        del rng
+        return {
+            "scenario": scenario.name,
+            "transport": transport,
+            "gates": gates,
+            "driver": driver,
+            "fleet": fleet_size,
+            "seed": seed,
+            "wakeups": {},
+            "passed": not violations,
+            "converged": converged,
+            "cycles": cycles,
+            "wall_s": round(time.monotonic() - started, 2),
+            "decisions": decisions_total,
+            "transitions": sum(len(r.tape.transitions) for r in rigs),
+            "violations": [v.to_dict() for v in violations],
+        }
+    finally:
+        for rig in rigs:
+            rig.close()
+        metrics.set_default_registry(prev_registry)
+        events_mod.set_default_log(prev_log)
+        timeline_mod.set_default_recorder(prev_recorder)
+
+
+#: the coordinator's "rolling" phase name (imported lazily to keep the
+#: module import graph acyclic — federation imports chaos's SimFleet)
+PHASE_ROLLING_FED = "rolling"
 
 
 SCENARIOS: Dict[str, Scenario] = {
@@ -1218,6 +1634,30 @@ SCENARIOS: Dict[str, Scenario] = {
                 )
                 else "breaker never tripped"
             ),
+        ),
+        Scenario(
+            name="federated-cell-failover",
+            description="fleet-of-fleets: a cell's apiserver dies "
+            "mid-global-wave — the coordinator holds later cells "
+            "(no admission while the wave is blind), resumes when the "
+            "cell answers again, and the whole wave converges",
+            transports=("inmem",),
+            gates=("on",),
+            drivers=("polling",),
+            runner=_run_federated_cell,
+            max_cycles=120,
+        ),
+        Scenario(
+            name="federated-cell-brownout",
+            description="fleet-of-fleets: one cell's SLO burn breaches "
+            "while its rollout is complete — promotion holds on the "
+            "advanceOn condition, healthy cells are unaffected, and "
+            "the wave resumes when the burn clears",
+            transports=("inmem",),
+            gates=("on",),
+            drivers=("polling",),
+            runner=_run_federated_cell,
+            max_cycles=120,
         ),
     )
 }
@@ -1605,6 +2045,10 @@ def run_cell(
 ) -> dict:
     """Run one campaign cell end-to-end and check every invariant.
     Returns the cell's scorecard row."""
+    if scenario.runner is not None:
+        return scenario.runner(
+            scenario, transport, gates, fleet_size, seed, driver
+        )
     started = time.monotonic()
     cell = CampaignCell(
         scenario, transport, gates, fleet_size, seed, driver=driver

@@ -109,6 +109,17 @@ class ClusterUpgradeState:
     _all_memo: Optional[List[NodeUpgradeState]] = field(
         default=None, repr=False, compare=False
     )
+    #: Generic per-snapshot memo table for O(fleet) ANNOTATION scans
+    #: (the pacing stamp census, the canary exposure walk — see
+    #: :meth:`scan_memo`).  The flatten memos above removed the
+    #: repeated list builds; these remove the repeated per-node
+    #: annotation parses that sat on top of them: within one pass the
+    #: scheduler, the analysis exposure census and rollout_status each
+    #: re-walked every node's admitted-at/done-at annotations.
+    #: Invalidated together with the flattens (cascade bucket
+    #: migration — which is also what admission writes trigger, so a
+    #: memo can never serve stamps from before this pass's writes).
+    _scan_memos: dict = field(default_factory=dict, repr=False, compare=False)
 
     def nodes_in(self, state: str) -> List[NodeUpgradeState]:
         return self.node_states.get(state, [])
@@ -176,11 +187,28 @@ class ClusterUpgradeState:
             if state in consts.ALL_STATES
         )
 
+    def scan_memo(self, key, builder):
+        """Per-snapshot memo for an O(fleet) derived scan: the first
+        caller under *key* pays the walk via *builder()*, every later
+        caller in the same pass shares the result.  Keys must encode
+        everything the scan depends on besides the snapshot itself
+        (e.g. ``("canary-walk", slice_aware)``).  Cleared by
+        :meth:`invalidate_census`, which every bucket mutation (and
+        thus every admission write) triggers — a stale memo can never
+        outlive the state it was derived from."""
+        memos = self._scan_memos
+        if key in memos:
+            return memos[key]
+        value = builder()
+        memos[key] = value
+        return value
+
     def invalidate_census(self) -> None:
-        """Drop the flatten memos after a bucket mutation (cascade
-        bucket migration is the one in-pass mutator)."""
+        """Drop the flatten + scan memos after a bucket mutation
+        (cascade bucket migration is the one in-pass mutator)."""
         self._managed_memo = None
         self._all_memo = None
+        self._scan_memos.clear()
 
 
 class CommonUpgradeManager:

@@ -211,6 +211,16 @@ UPGRADE_QUARANTINE_TAINT_KEY_FMT = DOMAIN + "/%s-upgrade.quarantined"
 #: node objects already in its snapshot (see upgrade/timeline.py).
 UPGRADE_TIMELINE_ANNOTATION_KEY_FMT = DOMAIN + "/%s-upgrade.timeline"
 
+#: DaemonSet annotation (on the AUDIT cell's driver DaemonSet) holding
+#: the federation coordinator's record (JSON: per-cell phase +
+#: admitted/completed/promoted stamps + the global-breaker record) —
+#: cell-wave progress survives coordinator restarts exactly like the
+#: per-cluster breaker record survives operator restarts (see
+#: federation/coordinator.py).
+UPGRADE_FEDERATION_RECORD_ANNOTATION_KEY_FMT = (
+    DOMAIN + "/%s-upgrade.federation-record"
+)
+
 #: Value prefix marking a quarantine annotation as REMEDIATION-owned
 #: (retry budget exhausted) rather than health-owned; the
 #: SliceHealthManager only lifts health-owned quarantines.

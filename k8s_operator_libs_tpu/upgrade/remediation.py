@@ -375,6 +375,7 @@ class RemediationManager:
         common: CommonUpgradeManager,
         reason: str,
         now: Optional[float] = None,
+        event_reason: str = "slo",
     ) -> Optional[RemediationDecision]:
         """Trip the breaker on an ANALYSIS verdict (a sustained SLO
         breach — see :mod:`.analysis`) instead of the failure census:
@@ -384,7 +385,14 @@ class RemediationManager:
         ``autoRollback`` — reverts to the last-known-good revision in
         the same pass, exactly like a failure-budget trip.  No-ops (and
         returns the standing decision) when the breaker is already open
-        for the current target or the engine is off."""
+        for the current target or the engine is off.
+
+        *event_reason* is the decision-stream reason code the trip is
+        audited under — ``"slo"`` for the analysis engine's aborts,
+        ``"federation"`` when the federation coordinator drives this
+        cell's rollback off the GLOBAL failure-budget rollup
+        (:mod:`..federation`); it must stay registered in
+        :data:`~..obs.events.EVENT_REASONS` for ``BreakerTripped``."""
         spec = getattr(policy, "remediation", None)
         if spec is None:
             return None
@@ -424,7 +432,7 @@ class RemediationManager:
         metrics.record_breaker_trip()
         events_mod.emit(
             events_mod.EVENT_BREAKER_TRIPPED,
-            "slo",
+            event_reason,
             events_mod.FLEET_TARGET,
             reason,
         )

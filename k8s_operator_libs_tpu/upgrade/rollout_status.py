@@ -649,10 +649,10 @@ def _evaluate_gates(state, policy, analysis=None) -> List[GateStatus]:
             )
 
     if policy.max_nodes_per_hour > 0:
-        budget = schedule.pacing_budget(policy, all_nodes)
+        budget = schedule.pacing_budget(policy, all_nodes, state=state)
         if budget is not None and budget <= 0:
             next_at = schedule.next_pacing_slot_at(
-                all_nodes, policy.max_nodes_per_hour
+                all_nodes, policy.max_nodes_per_hour, state=state
             )
             next_iso = (
                 datetime.fromtimestamp(next_at, tz=timezone.utc).isoformat()

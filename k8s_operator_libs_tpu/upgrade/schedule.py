@@ -96,13 +96,12 @@ def next_window_open(
     return None
 
 
-def _recent_stamps(
-    nodes: Iterable[JsonObj], now_ts: float, window_seconds: float
-) -> list:
-    """Admitted-at timestamps inside the trailing window, bypass-exempt
-    admissions excluded — the single source of the pacing census (both
-    the budget and the next-slot time derive from it, so they can never
-    disagree on boundary/exemption semantics)."""
+def _all_stamps(nodes: Iterable[JsonObj]) -> tuple:
+    """EVERY parsed (non-bypass-exempt) admitted-at timestamp for the
+    given nodes, window-independent — the one O(fleet) annotation walk
+    the per-snapshot memo caches (:meth:`~.common_manager
+    .ClusterUpgradeState.scan_memo`); the trailing-window filter is the
+    cheap per-call part."""
     key = util.get_admitted_at_annotation_key()
     bypass_key = util.get_admitted_bypass_annotation_key()
     stamps = []
@@ -114,18 +113,47 @@ def _recent_stamps(
         if annotations.get(bypass_key):
             continue  # pacing-exempt bypass admission
         try:
-            ts = float(raw)
+            stamps.append(float(raw))
         except ValueError:
             continue
-        if now_ts - ts < window_seconds:
-            stamps.append(ts)
-    return stamps
+    return tuple(stamps)
+
+
+def _recent_stamps(
+    nodes: Iterable[JsonObj],
+    now_ts: float,
+    window_seconds: float,
+    state=None,
+) -> list:
+    """Admitted-at timestamps inside the trailing window, bypass-exempt
+    admissions excluded — the single source of the pacing census (both
+    the budget and the next-slot time derive from it, so they can never
+    disagree on boundary/exemption semantics).
+
+    With *state* (a :class:`~.common_manager.ClusterUpgradeState`) the
+    underlying annotation walk rides the snapshot's scan memo: within
+    one reconcile the scheduler, rollout_status and the requestor each
+    asked for this census, and each paid the full O(fleet) parse —
+    ROADMAP item 2's last named scan.  *nodes* is ignored in that case
+    (the memo walks the snapshot's own all-bucket flatten, which is
+    exactly what every caller passed)."""
+    if state is not None:
+        stamps = state.scan_memo(
+            ("pacing-stamps",),
+            lambda: _all_stamps(
+                ns.node for ns in state.all_node_states()
+            ),
+        )
+    else:
+        stamps = _all_stamps(nodes)
+    return [ts for ts in stamps if now_ts - ts < window_seconds]
 
 
 def count_recent_admissions(
     nodes: Iterable[JsonObj],
     now_ts: Optional[float] = None,
     window_seconds: float = PACING_WINDOW_SECONDS,
+    state=None,
 ) -> int:
     """Nodes whose admitted-at stamp lies inside the trailing window.
 
@@ -134,7 +162,7 @@ def count_recent_admissions(
     bypasses starve the next hour's planned-admission budget."""
     if now_ts is None:
         now_ts = _time.time()
-    return len(_recent_stamps(nodes, now_ts, window_seconds))
+    return len(_recent_stamps(nodes, now_ts, window_seconds, state=state))
 
 
 def stamp_admission(
@@ -176,13 +204,16 @@ def stamp_admission(
         )
 
 
-def pacing_budget(policy, state_nodes: Iterable[JsonObj]) -> Optional[int]:
+def pacing_budget(
+    policy, state_nodes: Iterable[JsonObj], state=None
+) -> Optional[int]:
     """Remaining node admissions this trailing hour, or None when pacing
-    is off."""
+    is off.  Pass *state* so the stamp walk rides the snapshot's scan
+    memo (see :func:`_recent_stamps`)."""
     limit = getattr(policy, "max_nodes_per_hour", 0) or 0
     if limit <= 0:
         return None
-    return max(0, limit - count_recent_admissions(state_nodes))
+    return max(0, limit - count_recent_admissions(state_nodes, state=state))
 
 
 def next_pacing_slot_at(
@@ -190,6 +221,7 @@ def next_pacing_slot_at(
     limit: int,
     now_ts: Optional[float] = None,
     window_seconds: float = PACING_WINDOW_SECONDS,
+    state=None,
 ) -> Optional[float]:
     """When the trailing-hour budget next frees a slot (unix seconds), or
     None if a slot is already free / pacing is off.  A counted admission
@@ -200,7 +232,7 @@ def next_pacing_slot_at(
         return None
     if now_ts is None:
         now_ts = _time.time()
-    stamps = _recent_stamps(nodes, now_ts, window_seconds)
+    stamps = _recent_stamps(nodes, now_ts, window_seconds, state=state)
     if len(stamps) < limit:
         return None  # budget not exhausted
     stamps.sort()

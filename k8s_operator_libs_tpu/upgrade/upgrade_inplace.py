@@ -109,31 +109,23 @@ class CanaryCensus:
     soak_until: Optional[float] = None
 
 
-def canary_census(
-    state: ClusterUpgradeState,
-    policy: UpgradePolicySpec,
-    now: Optional[float] = None,
-) -> CanaryCensus:
-    """Compute the canary stage's exposure accounting (see
-    :meth:`InplaceNodeStateManager._canary_budget` for the full
-    semantics; this is its census, extracted pure so RolloutStatus can
-    explain a frozen canary — which unit failed — without a manager).
-
-    With ``policy.canary_soak_seconds`` a successful unit only counts
-    toward opening the fleet once its newest member done-at stamp is
-    older than the soak window (the bake gate).  Nodes done WITHOUT a
-    stamp (upgraded before the stamp existed) count as already soaked —
-    degrading open, never wedging the gate forever."""
-    import time as _time
-
+def _canary_walk(
+    state: ClusterUpgradeState, slice_aware: bool
+) -> tuple:
+    """The canary census' single O(fleet) annotation walk:
+    ``(stamped, not_done, failed_units, done_at)`` in census units.
+    Memoized per snapshot via :meth:`~.common_manager
+    .ClusterUpgradeState.scan_memo` — within one reconcile the
+    scheduler's canary budget, the analysis exposure census and
+    rollout_status each recomputed it; the wall-clock-dependent soak
+    math stays per call on top of this walk."""
     from ..cluster.objects import get_annotation, name_of
 
     key = util.get_admitted_at_annotation_key()
     done_key = util.get_done_at_annotation_key()
-    now_ts = _time.time() if now is None else now
 
     def unit_of(node):
-        if policy.slice_aware:
+        if slice_aware:
             return topology.domain_of(node)
         return "node:" + name_of(node)
 
@@ -160,6 +152,32 @@ def canary_census(
                 done_at[unit] = max(done_at.get(unit, 0.0), ts)
             if bucket == consts.UPGRADE_STATE_FAILED:
                 failed_units.add(unit)
+    return stamped, not_done, failed_units, done_at
+
+
+def canary_census(
+    state: ClusterUpgradeState,
+    policy: UpgradePolicySpec,
+    now: Optional[float] = None,
+) -> CanaryCensus:
+    """Compute the canary stage's exposure accounting (see
+    :meth:`InplaceNodeStateManager._canary_budget` for the full
+    semantics; this is its census, extracted pure so RolloutStatus can
+    explain a frozen canary — which unit failed — without a manager).
+
+    With ``policy.canary_soak_seconds`` a successful unit only counts
+    toward opening the fleet once its newest member done-at stamp is
+    older than the soak window (the bake gate).  Nodes done WITHOUT a
+    stamp (upgraded before the stamp existed) count as already soaked —
+    degrading open, never wedging the gate forever."""
+    import time as _time
+
+    now_ts = _time.time() if now is None else now
+    slice_aware = bool(policy.slice_aware)
+    stamped, not_done, failed_units, done_at = state.scan_memo(
+        ("canary-walk", slice_aware),
+        lambda: _canary_walk(state, slice_aware),
+    )
     successful = stamped - not_done
     in_flight = stamped - successful
     soak = policy.canary_soak_seconds
@@ -311,7 +329,7 @@ class InplaceNodeStateManager:
             available = 0
             window_closed = True
         pacing = schedule.pacing_budget(
-            policy, (ns.node for ns in state.all_node_states())
+            policy, (ns.node for ns in state.all_node_states()), state=state
         )
         canary = None
         if policy.canary_domains > 0:
@@ -400,6 +418,13 @@ class InplaceNodeStateManager:
                 exposure=exposure,
             )
         if admitted:
+            # Admission writes stamped admitted-at annotations on the
+            # snapshot's node dicts in place: drop the scan memos so
+            # post-apply consumers of the SAME snapshot (explain /
+            # rollout_status on the manager's last state) re-derive the
+            # pacing/canary censuses from the written values.  (With
+            # cascade on, the bucket migration already invalidated.)
+            state.invalidate_census()
             # One wave-summary decision per admitting pass (repeats
             # aggregate; the message keeps the latest wave's shape).
             log.emit(

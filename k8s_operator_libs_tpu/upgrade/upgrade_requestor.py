@@ -375,7 +375,7 @@ class RequestorNodeStateManager:
         if window_closed:
             logger.info("outside maintenance window; no new maintenance handoffs")
         pacing = schedule.pacing_budget(
-            policy, (ns.node for ns in state.all_node_states())
+            policy, (ns.node for ns in state.all_node_states()), state=state
         )
         for node_state in state.nodes_in(consts.UPGRADE_STATE_UPGRADE_REQUIRED):
             node = node_state.node
@@ -432,6 +432,10 @@ class RequestorNodeStateManager:
             common.provider.change_node_upgrade_state(
                 node, consts.UPGRADE_STATE_NODE_MAINTENANCE_REQUIRED
             )
+            # the stamp mutated the snapshot's node dict in place: drop
+            # the scan memos so later same-snapshot censuses (status /
+            # explain) re-derive from the written values
+            state.invalidate_census()
 
     def process_node_maintenance_required_nodes(
         self, state: ClusterUpgradeState

@@ -256,6 +256,14 @@ def get_failure_target_annotation_key() -> str:
     )
 
 
+def get_federation_record_annotation_key() -> str:
+    """Federation: coordinator record annotation key (audit cell DS)."""
+    return (
+        consts.UPGRADE_FEDERATION_RECORD_ANNOTATION_KEY_FMT
+        % get_component_name()
+    )
+
+
 def get_timeline_annotation_key() -> str:
     """Flight recorder: per-node timeline checkpoint annotation key."""
     return consts.UPGRADE_TIMELINE_ANNOTATION_KEY_FMT % get_component_name()

@@ -135,6 +135,27 @@ def stubbed_probes(monkeypatch):
                 }
             ]
             * 5,
+            "annotation_memo_speedup_1024n": 99999.999,
+            "profile_annotation_removed": [
+                {
+                    "frame": "y" * 40,
+                    "old_pct": 99.99,
+                    "new_pct": 99.99,
+                    "delta_pct": 99.99,
+                }
+            ]
+            * 5,
+        },
+    )
+    monkeypatch.setattr(
+        bench,
+        "fed_section",
+        lambda *a, **k: {
+            "fed_cells_total": 9999,
+            "fed_cells_promoted": 9999,
+            "fed_promotion_lag_s": 99999.999,
+            "fed_merge_ms": 99999.99,
+            "fed_wall_s": 99999.99,
         },
     )
     monkeypatch.setattr(
@@ -228,6 +249,16 @@ TRACKED_DETAIL_KEYS = (
     "scale_65536_nodes_per_min",
     "scale_retention_65536_vs_8192",
     "census_memo_speedup_1024n",
+    # the annotation-scan memo (ISSUE 15 perf satellite): the pacing/
+    # canary census incremental-ization ratio rides beside the census
+    # memo's
+    "annotation_memo_speedup_1024n",
+    # the federation acceptance (ISSUE 15): cell count, the
+    # coordinator's promotion lag, and the merged-audit cost must be
+    # trackable per round
+    "fed_cells_total",
+    "fed_promotion_lag_s",
+    "fed_merge_ms",
     # the resilience scorecard (ISSUE 13): cells passed/total across
     # the default chaos campaign's scenario × axis matrix — a
     # resilience regression must be as visible per round as a speed one

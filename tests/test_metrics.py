@@ -476,6 +476,12 @@ class TestAlertRulesStayInSync:
             m.record_pacing_adjustment("decrease")
             # decision-audit family (obs/events.py)
             m.record_upgrade_event("NodeDeferred", "budget")
+            # federation family (federation/coordinator.py)
+            m.publish_federation_gauges(
+                3, 1, False, -1, {"canary": "promoted"}
+            )
+            m.record_federation_trip()
+            m.record_cell_promotion()
             # event-driven reconcile family (controller/wakeup.py)
             m.record_reconcile_wakeup("watch")
             # write-pipeline family (async batched write dispatcher)
