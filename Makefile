@@ -13,7 +13,8 @@ BUILDIMAGE ?= k8s-operator-libs-tpu-build:dev
 	cov-report clean help image .build-image kind-e2e kind-e2e-stub \
 	tpu-smoke tpu-probe tpu-watch tpu-stage verify verify-obs \
 	verify-remediation verify-slo verify-events verify-profile \
-	verify-pacing verify-chaos verify-race verify-federation chaos
+	verify-pacing verify-chaos verify-chaos-search verify-race \
+	verify-federation chaos
 
 # Enforced coverage floor (VERDICT r4 next #6).  Full-suite line
 # coverage measured by the zero-dependency sys.monitoring tracer
@@ -95,6 +96,15 @@ verify-chaos:
 	$(PYTHON) -m pytest tests/test_chaos.py -q
 	$(PYTHON) -m k8s_operator_libs_tpu chaos --selftest
 
+# Chaos-search gate: the searcher/shrinker/ratchet suite plus the
+# self-proving end-to-end demo — a planted invariant bug is found by
+# fitness climb within a bounded 2-generation-scale search, shrunk to
+# a minimal deterministic reproducer, ratcheted into the matrix
+# (42 -> >=43 cells), then replayed GREEN once the bug is reverted.
+verify-chaos-search:
+	$(PYTHON) -m pytest tests/test_chaossearch.py -q
+	$(PYTHON) -m k8s_operator_libs_tpu chaos search --selftest
+
 # The full default campaign (12 fault scenarios × transport/gates/
 # driver axes, ~40 cells): the standing resilience scorecard, exit 1
 # on any failed cell.  Slower than verify-chaos; run when touching
@@ -133,8 +143,8 @@ verify-race:
 # The whole verify chain — every subsystem gate in one target (CI runs
 # this; each sub-gate stays runnable alone for the inner loop).
 verify: verify-obs verify-remediation verify-slo verify-events \
-	verify-profile verify-pacing verify-chaos verify-federation \
-	verify-race
+	verify-profile verify-pacing verify-chaos verify-chaos-search \
+	verify-federation verify-race
 
 lint:
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu examples bench.py __graft_entry__.py

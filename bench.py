@@ -1708,16 +1708,76 @@ def chaos_section(seed: int = 0, fleet: int = 8) -> dict:
     chaos_logger = logging_mod.getLogger("k8s_operator_libs_tpu")
     prev_level = chaos_logger.level
     chaos_logger.setLevel(logging_mod.ERROR)
+    from k8s_operator_libs_tpu.upgrade import chaossearch
+
+    campaign = chaos_mod.Campaign(seed=seed, fleet_size=fleet)
+    # the default campaign replays every ratcheted regression cell
+    # after the matrix — the searcher's monotone-growth contract
+    campaign.regression_cells = tuple(
+        chaossearch.load_regression_cells()
+    )
     try:
-        scorecard = chaos_mod.run_campaign(
-            chaos_mod.Campaign(seed=seed, fleet_size=fleet)
-        )
+        scorecard = chaos_mod.run_campaign(campaign)
     finally:
         chaos_logger.setLevel(prev_level)
     out = chaos_mod.compact_scorecard(scorecard)
     # the full per-cell detail rides only the pretty artifact (the
     # compact tail sheds lists anyway)
     out["chaos_cells"] = scorecard["cells"]
+    return out
+
+
+def chaos_search_section(seed: int = 0) -> dict:
+    """Coverage-guided chaos search status (upgrade/chaossearch.py): a
+    bounded 2-generation fitness-guided search over the inmem scenario
+    pool — ``chaos_search_best_fitness`` is the standing how-close-to-
+    a-violation number (< 1.0 means no mutated cell violated an
+    invariant; >= 1.0 means the searcher FOUND one and the finding
+    list rides the full artifact), and ``chaos_regression_cells`` is
+    the ratchet size (monotone).  ``BENCH_SKIP_CHAOS_SEARCH=1``
+    skips."""
+    if os.environ.get("BENCH_SKIP_CHAOS_SEARCH"):
+        return {"chaos_search_generations": 0, "chaos_search_skipped": True}
+    import logging as logging_mod
+
+    from k8s_operator_libs_tpu.upgrade import chaossearch
+
+    chaos_logger = logging_mod.getLogger("k8s_operator_libs_tpu")
+    prev_level = chaos_logger.level
+    chaos_logger.setLevel(logging_mod.ERROR)
+    try:
+        result = chaossearch.run_search(
+            chaossearch.SearchConfig(
+                seed=seed,
+                generations=2,
+                population=4,
+                elite=2,
+                fleet_size=4,
+                budget_cells=12,
+                scenarios=(
+                    "policy-edits",
+                    "ha-failover",
+                    "event-gc-race",
+                ),
+                transports=("inmem",),
+            )
+        )
+    finally:
+        chaos_logger.setLevel(prev_level)
+    out = {
+        "chaos_search_generations": len(result["generations"]),
+        "chaos_search_best_fitness": round(result["best_fitness"], 4),
+        "chaos_regression_cells": len(
+            chaossearch.load_regression_cells()
+        ),
+        "chaos_search_cells": result["cells_run"],
+        "chaos_search_found": len(result["found"]),
+        "chaos_search_wall_s": result["wall_s"],
+    }
+    if result["found"]:
+        # the finding detail rides only the pretty artifact (the
+        # compact prune drops lists)
+        out["chaos_search_findings"] = result["found"]
     return out
 
 
@@ -1863,6 +1923,11 @@ def main() -> None:
     # cell)
     chaos = chaos_section()
 
+    # ---- coverage-guided chaos search: a bounded 2-generation
+    # fitness-guided sweep over the inmem scenario pool + the ratchet
+    # size (best fitness < 1.0 = no mutated cell violated an invariant)
+    chaos_search = chaos_search_section()
+
     # ---- concurrency sanitizer: static lockcheck sweep + one
     # racewatch-instrumented event-driver cell (zero findings / zero
     # lock-order cycles is the contract; top holders ride shed-listed).
@@ -1949,6 +2014,11 @@ def main() -> None:
                     **scale,
                     **remediation,
                     **{k: v for k, v in chaos.items() if k != "chaos_cells"},
+                    **{
+                        k: v
+                        for k, v in chaos_search.items()
+                        if k != "chaos_search_findings"
+                    },
                     **race,
                     **event_driven,
                     **census,
@@ -2019,6 +2089,9 @@ def main() -> None:
                     # full per-cell chaos detail: pretty artifact only
                     # (the compact prune drops lists)
                     "chaos_cells": chaos.get("chaos_cells", []),
+                    "chaos_search_findings": chaos_search.get(
+                        "chaos_search_findings", []
+                    ),
                     "tpu": tpu_section(),
                     "compute_cpu": compute_cpu_section(),
                 },
@@ -2064,6 +2137,14 @@ COMPACT_SHED_FIRST = (
     "census_cycle_ms_1024n",
     "chaos_wall_s",
     "chaos_violations",
+    "chaos_search_wall_s",
+    "chaos_search_cells",
+    "chaos_search_found",
+    # derivable twins: the incremental speedup and the 65k retention
+    # ratio already track these
+    "build_state_incremental_ms_4096n",
+    "scale_8192_nodes_per_min",
+    "scale_16384_nodes_per_min",
     "scale_65536_wall_s",
     "engine.idx_on_512n_wall_s",
     "engine.idx_off_512n_wall_s",

@@ -1020,6 +1020,8 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         # misleading resilience failure instead of a usage error
         print(f"--fleet must be >= 1, got {args.fleet}", file=sys.stderr)
         return 2
+    if args.mode == "search":
+        return _cmd_chaos_search(args)
     if args.selftest:
         try:
             print(chaos_mod.selftest())
@@ -1068,6 +1070,16 @@ def cmd_chaos(args: argparse.Namespace) -> int:
             seed=args.seed if args.seed is not None else 0,
             fleet_size=args.fleet if args.fleet is not None else 8,
         )
+        if not args.scenario:
+            # the DEFAULT campaign replays every ratcheted regression
+            # cell after the matrix (the monotone-growth contract); a
+            # --scenario filter or a campaign file opts out — the file
+            # declares its own "regressions_file" when it wants them
+            from .upgrade import chaossearch
+
+            campaign.regression_cells = tuple(
+                chaossearch.load_regression_cells()
+            )
     if args.scenario:
         unknown = [
             s for s in args.scenario if s not in chaos_mod.SCENARIOS
@@ -1100,6 +1112,115 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     else:
         print(chaos_mod.render_scorecard(scorecard))
     return 0 if scorecard["cells_failed"] == 0 else 1
+
+
+def _cmd_chaos_search(args: argparse.Namespace) -> int:
+    """``chaos search``: the fitness-guided mutation searcher
+    (upgrade/chaossearch.py).  Exit 0 when no mutated cell violated an
+    invariant within the budget, 1 when the search FOUND a violation
+    (that is the searcher succeeding at its job — the finding needs a
+    fix), 2 on usage errors.  ``--shrink`` reduces each finding to a
+    minimal reproducer; ``--ratchet [PATH]`` appends reproducers to
+    the regression-cell file the default campaign replays."""
+    from .upgrade import chaossearch
+
+    progress = None
+    if not args.json:
+        progress = lambda msg: print(msg, file=sys.stderr)  # noqa: E731
+    if args.selftest:
+        try:
+            print(chaossearch.selftest(progress=progress))
+        except AssertionError as err:
+            print(
+                f"chaos search selftest FAILED: {err}", file=sys.stderr
+            )
+            return 1
+        return 0
+    table = chaossearch.resolve_scenarios()
+    unknown = [s for s in args.scenario if s not in table]
+    if unknown:
+        print(
+            f"unknown scenario(s) {', '.join(unknown)} — see "
+            "`chaos --list`",
+            file=sys.stderr,
+        )
+        return 2
+    if args.generations < 1 or args.population < 1 or args.budget < 1:
+        print(
+            "--generations/--population/--budget must be >= 1",
+            file=sys.stderr,
+        )
+        return 2
+    config = chaossearch.SearchConfig(
+        seed=args.seed if args.seed is not None else 0,
+        generations=args.generations,
+        population=args.population,
+        budget_cells=args.budget,
+        fleet_size=args.fleet if args.fleet is not None else 5,
+        scenarios=tuple(args.scenario),
+        transports=tuple(args.transport) or ("inmem", "http"),
+    )
+    result = chaossearch.run_search(config, progress=progress)
+    reproducers = []
+    ratcheted = []
+    if result["found"] and (args.shrink or args.ratchet is not None):
+        for finding in result["found"]:
+            rep = chaossearch.shrink(
+                config.seed, finding["candidate"], progress=progress
+            )
+            reproducers.append(rep)
+            if args.ratchet is not None:
+                ratcheted.append(
+                    chaossearch.ratchet_cell(
+                        rep,
+                        path=args.ratchet or None,
+                        note="chaos search",
+                    )
+                )
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    **result,
+                    "reproducers": reproducers,
+                    "ratcheted": ratcheted,
+                }
+            )
+        )
+    else:
+        gens = result["generations"]
+        best = result["best_fitness"]
+        print(
+            f"chaos search (seed {config.seed}): "
+            f"{result['cells_run']} cells over {len(gens)} "
+            f"generation(s), best fitness {best}, "
+            f"{len(result['found'])} violation(s) found "
+            f"in {result['wall_s']:.1f}s"
+        )
+        for g in gens:
+            print(
+                f"  gen {g['generation']}: best={g['best_fitness']} "
+                f"mean={g['mean_fitness']} cells={g['cells_run']}"
+            )
+        for f in result["found"]:
+            print(
+                f"  FOUND {f['candidate']['scenario']}"
+                f"/{f['candidate']['transport']}"
+                f"/gates-{f['candidate']['gates']}"
+                f"/{f['candidate']['driver']} "
+                f"seed={f['seed']}: {', '.join(f['violations'])}"
+            )
+        for rep in reproducers:
+            print(
+                "  shrunk to "
+                f"{json.dumps(rep['candidate']['mutations'])} "
+                f"fleet={rep['candidate']['fleet']} "
+                f"seed={rep['seed']} in {rep['runs']} runs"
+            )
+        for r in ratcheted:
+            mark = "ratcheted" if r["added"] else "already ratcheted"
+            print(f"  {mark}: {r['cell']['cell']} -> {r['path']}")
+    return 1 if result["found"] else 0
 
 
 def _load_profile_dump(path: str):
@@ -1753,10 +1874,22 @@ def main(argv=None) -> int:
         "checker can fail",
     )
     ch.add_argument(
+        "mode",
+        nargs="?",
+        choices=("run", "search"),
+        default="run",
+        help="run = sweep the campaign matrix (default); search = "
+        "fitness-guided mutation search over the fault space "
+        "(upgrade/chaossearch.py): mutate cell parameters generation "
+        "over generation, score by proximity to an invariant "
+        "violation, exit 1 when one is found",
+    )
+    ch.add_argument(
         "--campaign",
         default="",
-        help="campaign file (JSON: name/seed/fleet/scenarios/axes); "
-        "default: the full built-in campaign",
+        help="campaign file (JSON: name/seed/fleet/scenarios/axes/"
+        "regression_cells/regressions_file); default: the full "
+        "built-in campaign plus every ratcheted regression cell",
     )
     ch.add_argument(
         "--scenario",
@@ -1806,9 +1939,47 @@ def main(argv=None) -> int:
     ch.add_argument(
         "--selftest",
         action="store_true",
-        help="run one real brownout cell end-to-end (converges, every "
-        "invariant green) then prove the checker flags a deliberately "
-        "broken invariant — the make verify-chaos gate",
+        help="run mode: one real brownout cell end-to-end then prove "
+        "the checker flags a deliberately broken invariant (the make "
+        "verify-chaos gate); search mode: plant a known bug, climb to "
+        "it, shrink it, ratchet it, replay it green once fixed (the "
+        "make verify-chaos-search gate)",
+    )
+    ch.add_argument(
+        "--generations",
+        type=int,
+        default=3,
+        help="search mode: breeding generations (default 3)",
+    )
+    ch.add_argument(
+        "--population",
+        type=int,
+        default=6,
+        help="search mode: candidates per generation (default 6)",
+    )
+    ch.add_argument(
+        "--budget",
+        type=int,
+        default=48,
+        help="search mode: max NEW cell evaluations across the whole "
+        "search (cached elites are free; default 48)",
+    )
+    ch.add_argument(
+        "--shrink",
+        action="store_true",
+        help="search mode: delta-debug each finding down to a minimal "
+        "deterministic reproducer",
+    )
+    ch.add_argument(
+        "--ratchet",
+        nargs="?",
+        const="",
+        default=None,
+        metavar="PATH",
+        help="search mode: append each shrunk reproducer to the "
+        "regression-cell file (implies --shrink; default PATH: "
+        "hack/chaos_regressions.json, replayed by the default "
+        "campaign)",
     )
     ch.set_defaults(func=cmd_chaos)
 

@@ -4,7 +4,7 @@ This is layer L1 of the stack (SURVEY.md §1) — the analog of
 controller-runtime client + client-go + envtest in the reference.
 """
 
-from .apiserver import ApiServerFacade
+from .apiserver import FAULT_KINDS, ApiServerFacade, FaultSpec
 from .cache import InformerCache
 from .client import KIND_REGISTRY, ClusterClient, KindInfo, kind_info, register_kind
 from .errors import (
@@ -36,6 +36,8 @@ from .selectors import labels_to_selector, match_label_selector, matches, parse_
 
 __all__ = [
     "ApiServerFacade",
+    "FAULT_KINDS",
+    "FaultSpec",
     "ClusterClient",
     "KindInfo",
     "KIND_REGISTRY",

@@ -43,6 +43,7 @@ import json
 import logging
 import threading
 import time
+from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, parse_qsl, urlparse
@@ -78,6 +79,86 @@ logger = logging.getLogger(__name__)
 #: latency brownout under a targeted partition hook with two chained
 #: calls instead of one call that knows every knob.
 _UNSET = object()
+
+#: the retractable fault kinds (ApiServerFacade.clear_fault_kind /
+#: FaultSpec.cleared): each names the knob group that makes one fault
+#: fire and the fault_counters key that proves it fired.
+FAULT_KINDS = ("chaos", "latency", "held-stream")
+
+
+@dataclass
+class FaultSpec:
+    """A serializable slice of the seeded fault stack: the knobs that
+    are plain data (ratios, frame caps, latencies, seeds) — the hook
+    knobs (request/partition/body) stay code and compose through
+    :meth:`ApiServerFacade.with_faults` directly.
+
+    ``apply`` LAYERS the spec onto the live stack with the same
+    partial-update semantics as with_faults: a default-valued (off)
+    knob is left untouched, so two specs targeting different kinds
+    compose across two apply calls.  Retraction is by KIND —
+    ``facade.clear_fault_kind(kind)`` (or ``spec.cleared(kind)`` for
+    the data) turns exactly one fault off mid-scenario while sibling
+    kinds keep firing AND keep counting: fault_counters is never
+    touched by a clear, so evidence probes on composed stacks cannot
+    under-count."""
+
+    chaos_drop_ratio: float = 0.0
+    chaos_seed: int = 0
+    request_latency_seconds: float = 0.0
+    latency_seed: Optional[int] = None
+    held_stream_max_frames: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "chaos_drop_ratio": self.chaos_drop_ratio,
+            "chaos_seed": self.chaos_seed,
+            "request_latency_seconds": self.request_latency_seconds,
+            "latency_seed": self.latency_seed,
+            "held_stream_max_frames": self.held_stream_max_frames,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "FaultSpec":
+        spec = cls()
+        unknown = set(data) - set(spec.to_dict())
+        if unknown:
+            raise ValueError(
+                f"unknown FaultSpec field(s) {sorted(unknown)} "
+                f"(known: {sorted(spec.to_dict())})"
+            )
+        return cls(**data)
+
+    def cleared(self, kind: str) -> "FaultSpec":
+        """A copy with *kind*'s knobs back at their defaults."""
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} (kinds: {FAULT_KINDS})"
+            )
+        out = FaultSpec(**self.to_dict())
+        if kind == "chaos":
+            out.chaos_drop_ratio = 0.0
+            out.chaos_seed = 0
+        elif kind == "latency":
+            out.request_latency_seconds = 0.0
+            out.latency_seed = None
+        elif kind == "held-stream":
+            out.held_stream_max_frames = 0
+        return out
+
+    def apply(self, facade: "ApiServerFacade") -> "ApiServerFacade":
+        if self.chaos_drop_ratio:
+            facade.with_chaos(self.chaos_drop_ratio, seed=self.chaos_seed)
+        if self.request_latency_seconds:
+            facade.with_faults(
+                request_latency_seconds=self.request_latency_seconds,
+                latency_seed=self.latency_seed,
+            )
+        if self.held_stream_max_frames:
+            facade.with_faults(
+                held_stream_max_frames=self.held_stream_max_frames
+            )
+        return facade
 
 _REASONS = {
     UnauthorizedError: "Unauthorized",
@@ -1029,6 +1110,29 @@ class ApiServerFacade:
         cls.body_hook = None
         cls.chaos_drop_ratio = 0.0
         cls.chaos_rng = None
+        return self
+
+    def clear_fault_kind(self, kind: str) -> "ApiServerFacade":
+        """Retract exactly ONE fault kind (:data:`FAULT_KINDS`)
+        mid-scenario, leaving sibling kinds firing.  The counters in
+        :data:`fault_counters` are deliberately untouched — including
+        the cleared kind's own tally (it is the evidence of what
+        already fired) and, critically, the SIBLINGS' tallies, which
+        keep incrementing: a composed stack that sheds its latency
+        layer must not stop proving its chaos drops."""
+        cls = self._handler_cls
+        if kind == "chaos":
+            cls.chaos_drop_ratio = 0.0
+            cls.chaos_rng = None
+        elif kind == "latency":
+            cls.request_latency_seconds = 0.0
+            cls.latency_rng = None
+        elif kind == "held-stream":
+            cls.held_stream_max_frames = 0
+        else:
+            raise ValueError(
+                f"unknown fault kind {kind!r} (kinds: {FAULT_KINDS})"
+            )
         return self
 
     @property

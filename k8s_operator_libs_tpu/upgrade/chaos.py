@@ -29,7 +29,13 @@ COMPOSES them into a repeatable resilience scorecard:
   closed;
 * results land as a compact **scorecard** artifact (``chaos`` CLI,
   ``bench.py`` tail) so regressions in *resilience* are tracked per
-  round exactly like regressions in speed.
+  round exactly like regressions in speed;
+* the checker also emits graded **fitness signals** (budget headroom at
+  settled points, breaker margin, audit near-gap width, decision-stream
+  anomaly density, stream-parity slack) so the coverage-guided searcher
+  (:mod:`.chaossearch`) can climb toward violations instead of merely
+  enumerating cells, and ratcheted **regression cells** (minimal
+  reproducers the searcher shrank) ride the campaign after the matrix.
 
 :data:`LEGAL_TRANSITIONS` lives here as the canonical edge set of the
 reference lifecycle graph (SURVEY.md §2); the resilience test suite
@@ -269,6 +275,16 @@ class AuditTape:
         self.cr_seqs: List[int] = []
         self.gaps = 0
         self.budget_violations: List[str] = []
+        #: graded fitness-signal inputs (``fitness_signals``): the
+        #: TIGHTEST margins observed at settled points, not just
+        #: pass/fail — a searcher needs to know how close a healthy
+        #: cell came to the cliff, not only whether it fell off
+        self.min_unavail_headroom: Optional[int] = None
+        self.min_parallel_headroom: Optional[int] = None
+        #: narrowest (cursor - eviction floor) observed while the
+        #: journal was actively evicting; None = eviction never ran
+        self.min_journal_slack: Optional[int] = None
+        self.journal_cap_seen: int = 0
         self._grace_active = 0
         self._grace_unavailable = 0
         self._nodes: Dict[str, JsonObj] = {
@@ -306,6 +322,19 @@ class AuditTape:
         points: post wait_idle each cycle).  An UNPLANNED retention gap
         counts — the checker fails the cell on it unless the scenario
         declared the roll."""
+        floor = getattr(self._store, "_journal_floor", 0)
+        if floor > 0:
+            # eviction is live: record how close the tape's cursor sits
+            # to the retention frontier (the near-gap width)
+            slack = self._cursor - floor
+            if (
+                self.min_journal_slack is None
+                or slack < self.min_journal_slack
+            ):
+                self.min_journal_slack = slack
+            self.journal_cap_seen = int(
+                getattr(self._store, "_journal_cap", 0) or 0
+            )
         try:
             events = self._store.events_since(self._cursor)
         except ExpiredError:
@@ -363,6 +392,12 @@ class AuditTape:
             return
         budget = policy.max_unavailable.scaled_value(total, round_up=True)
         allowed_unavail = max(budget, self._grace_unavailable)
+        headroom = allowed_unavail - unavailable
+        if (
+            self.min_unavail_headroom is None
+            or headroom < self.min_unavail_headroom
+        ):
+            self.min_unavail_headroom = headroom
         if unavailable > allowed_unavail and len(self.budget_violations) < 8:
             self.budget_violations.append(
                 f"{unavailable} unavailable exceeds maxUnavailable={budget} "
@@ -374,6 +409,12 @@ class AuditTape:
             allowed_active = max(
                 policy.max_parallel_upgrades, self._grace_active
             )
+            p_headroom = allowed_active - active
+            if (
+                self.min_parallel_headroom is None
+                or p_headroom < self.min_parallel_headroom
+            ):
+                self.min_parallel_headroom = p_headroom
             if active > allowed_active and len(self.budget_violations) < 8:
                 self.budget_violations.append(
                     f"{active} concurrent upgrades exceed "
@@ -687,6 +728,167 @@ def check_rollout_invariants(
 
 
 # --------------------------------------------------------------------------
+# Graded fitness signals: how CLOSE a cell came to violating each
+# invariant family, not just whether it did.  The searcher
+# (:mod:`.chaossearch`) climbs these — a fixed matrix only needs the
+# binary verdict, a mutating one needs the gradient.  Every signal is
+# normalized to [0, 1] where 1 means "at the cliff edge"; an actual
+# violation dominates every signal (see fitness_score).
+# --------------------------------------------------------------------------
+FITNESS_SIGNALS = (
+    "budget-headroom",
+    "breaker-margin",
+    "audit-near-gap",
+    "decision-anomalies",
+    "stream-parity-slack",
+)
+
+#: decision types that mark a remediation/abort/hold episode — their
+#: density is the decision-stream anomaly count the searcher rewards
+ANOMALY_DECISION_TYPES = frozenset(
+    value
+    for value in (
+        getattr(events_mod, attr, None)
+        for attr in (
+            "EVENT_NODE_UPGRADE_FAILED",
+            "EVENT_NODE_RETRIED",
+            "EVENT_NODE_QUARANTINED",
+            "EVENT_NODE_DRAIN_FAILED",
+            "EVENT_BREAKER_TRIPPED",
+            "EVENT_ROLLBACK_STARTED",
+            "EVENT_SLO_BREACHED",
+            "EVENT_ANALYSIS_ABORTED",
+            "EVENT_CELL_HELD",
+        )
+    )
+    if value
+)
+
+
+def fitness_signals(
+    *,
+    tape: Optional[AuditTape] = None,
+    decisions: Optional[List[dict]] = None,
+    persisted_decisions: Optional[List[dict]] = None,
+    store: Optional[InMemoryCluster] = None,
+    policy: Optional[UpgradePolicySpec] = None,
+    ds_name: str = "",
+    ds_namespace: str = "",
+) -> Dict[str, float]:
+    """Proximity-to-violation signals over a finished cell, by name
+    (:data:`FITNESS_SIGNALS`).  Same inputs as the checker; pure — and
+    deterministic for a deterministic cell, which is what lets the
+    searcher treat fitness as part of the replay contract."""
+    decisions = decisions or []
+    signals = {name: 0.0 for name in FITNESS_SIGNALS}
+
+    # ---- budget headroom at settled points: 1/(1+h) so h=0 (one more
+    # unavailable node trips the budget) scores 1.0 and relaxes
+    # hyperbolically with slack
+    headrooms = []
+    if tape is not None:
+        if tape.min_unavail_headroom is not None:
+            headrooms.append(tape.min_unavail_headroom)
+        if tape.min_parallel_headroom is not None:
+            headrooms.append(tape.min_parallel_headroom)
+    if headrooms:
+        h = max(0, min(headrooms))
+        signals["budget-headroom"] = 1.0 / (1.0 + h)
+
+    # ---- remediation breaker margin: observed failure ratio against
+    # the trip threshold; a trip (or an open record) saturates
+    margin = 0.0
+    tripped = any(
+        d.get("type") == events_mod.EVENT_BREAKER_TRIPPED for d in decisions
+    )
+    remediation = getattr(policy, "remediation", None) if policy else None
+    if tripped:
+        margin = 1.0
+    elif remediation is not None:
+        failed = {
+            d.get("target")
+            for d in decisions
+            if d.get("type") == events_mod.EVENT_NODE_UPGRADE_FAILED
+        }
+        attempted = {
+            d.get("target")
+            for d in decisions
+            if d.get("type") == events_mod.EVENT_NODE_ADMITTED
+        }
+        if failed and attempted:
+            ratio = len(failed) / len(attempted)
+            threshold = remediation.failure_threshold or 1.0
+            margin = min(1.0, ratio / threshold)
+    if store is not None and ds_name and margin < 1.0:
+        try:
+            ds = store.get("DaemonSet", ds_name, ds_namespace)
+        except (ApiError, OSError):
+            ds = None
+        if ds is not None:
+            raw = ((ds.get("metadata") or {}).get("annotations") or {}).get(
+                util.get_breaker_annotation_key()
+            )
+            if raw:
+                try:
+                    record = json.loads(raw)
+                except ValueError:
+                    record = None
+                if record and record.get("state") == "open":
+                    margin = 1.0
+    signals["breaker-margin"] = margin
+
+    # ---- audit-continuity near-gap width: narrowest cursor-to-floor
+    # slack while the journal was evicting, normalized by the cap; an
+    # actual gap saturates
+    if tape is not None:
+        if tape.gaps:
+            signals["audit-near-gap"] = 1.0
+        elif tape.min_journal_slack is not None and tape.journal_cap_seen:
+            slack = max(0, tape.min_journal_slack)
+            cap = float(tape.journal_cap_seen)
+            signals["audit-near-gap"] = max(0.0, min(1.0, 1.0 - slack / cap))
+
+    # ---- decision-stream anomaly density (saturating count)
+    anomalies = sum(
+        1 for d in decisions if (d.get("type") or "") in ANOMALY_DECISION_TYPES
+    )
+    signals["decision-anomalies"] = anomalies / (anomalies + 4.0)
+
+    # ---- stream-parity slack: live decisions the persisted plane has
+    # not yet landed (sink lag).  The invariant breaks in the OTHER
+    # direction (persisted inventing decisions); lag is the distance to
+    # the cliff where a GC'd live stream would strand persisted extras
+    if persisted_decisions is not None:
+        persisted_triples = {
+            (d.get("type"), d.get("reason"), d.get("target"))
+            for d in persisted_decisions
+        }
+        lag = sum(
+            1
+            for d in decisions
+            if (d.get("type"), d.get("reason"), d.get("target"))
+            not in persisted_triples
+        )
+        signals["stream-parity-slack"] = lag / (lag + 4.0)
+    return signals
+
+
+def fitness_score(
+    signals: Dict[str, float], violations: Optional[List] = None
+) -> float:
+    """Collapse per-signal proximities into the searcher's scalar.  A
+    violating cell dominates EVERY non-violating one (1 + violation
+    count, always > 1); otherwise the mean over the signal vocabulary,
+    bounded below 1."""
+    if violations:
+        return round(1.0 + float(len(violations)), 4)
+    if not signals:
+        return 0.0
+    total = sum(float(signals.get(name, 0.0)) for name in FITNESS_SIGNALS)
+    return round(min(total / len(FITNESS_SIGNALS), 0.9999), 4)
+
+
+# --------------------------------------------------------------------------
 # Simulated fleet (library-resident analog of tests/harness.Fleet): a
 # driver DaemonSet + nodes + pods + the one DS-controller behavior the
 # state machine depends on — deleted driver pods are recreated at the
@@ -880,6 +1082,12 @@ class Scenario:
     evidence: Optional[Callable] = None
     #: checker relaxations/requirements (see check_rollout_invariants)
     expect: dict = field(default_factory=dict)
+    #: scenario tunables a mutation can rewrite without touching code:
+    #: plain JSON-able values read by setup/tick/runner hooks (e.g. the
+    #: federated runner's ``outage_cycles``/``hold_ticks``, the seeded
+    #: selftest scenario's ``stress`` level).  Part of the cell's
+    #: deterministic identity via the mutation vector in cell_seed.
+    params: dict = field(default_factory=dict)
     #: expected final revision hash ("rev1" for rollback scenarios)
     target: str = "rev2"
     #: facade construction overrides (http cells)
@@ -1197,6 +1405,11 @@ def _run_federated_cell(
     converged = False
     try:
         brownout = scenario.name == "federated-cell-brownout"
+        # mutation-reachable fault timing: how many coordinator ticks
+        # the outage lasts / the burn must hold before clearing (the
+        # historical constants 3 and 5 remain the defaults)
+        outage_cycles = int((scenario.params or {}).get("outage_cycles", 3))
+        hold_ticks = int((scenario.params or {}).get("hold_ticks", 5))
         rigs = [
             _FedRig("canary", per_cell, _fed_policy()),
             _FedRig("region", per_cell, _fed_policy()),
@@ -1298,7 +1511,7 @@ def _run_federated_cell(
                                     f"the region brownout ({phases})",
                                 )
                             )
-                        if int(notes.get("held_ticks", 0)) >= 5:
+                        if int(notes.get("held_ticks", 0)) >= hold_ticks:
                             burn["rate"] = 0.2  # brownout clears
                             notes["burn_cleared_at"] = cycle
             else:
@@ -1311,7 +1524,7 @@ def _run_federated_cell(
                     outage.down = True
                     fault_window = 1
                     notes["outage_at"] = cycle
-                elif fault_window and fault_window < 4:
+                elif fault_window and fault_window < 1 + outage_cycles:
                     fault_window += 1
                     if admitted["global"]:
                         violations.append(
@@ -1321,7 +1534,7 @@ def _run_federated_cell(
                                 "cell's apiserver was down",
                             )
                         )
-                elif fault_window >= 4 and outage.down:
+                elif fault_window >= 1 + outage_cycles and outage.down:
                     outage.down = False
                     notes["outage_cleared_at"] = cycle
             for rig in rigs:
@@ -1409,10 +1622,22 @@ def _run_federated_cell(
         # ---- the standard per-cell rollout invariants (each cell is a
         # normal single-cluster rollout underneath)
         decisions_total = len(coord_stream)
+        agg_signals = {name: 0.0 for name in FITNESS_SIGNALS}
         for rig in rigs:
             decisions = rig.log.export_stream()
             decisions_total += len(decisions)
             persisted = events_mod.decisions_from_cluster(rig.store)
+            rig_signals = fitness_signals(
+                tape=rig.tape,
+                decisions=decisions,
+                persisted_decisions=persisted,
+                store=rig.store,
+                policy=rig.policy,
+                ds_name=SimFleet.DS_NAME,
+                ds_namespace=SimFleet.NAMESPACE,
+            )
+            for sig_name, value in rig_signals.items():
+                agg_signals[sig_name] = max(agg_signals[sig_name], value)
             cell_violations = check_rollout_invariants(
                 rig.store,
                 managed_nodes=rig.fleet.managed_nodes,
@@ -1436,6 +1661,10 @@ def _run_federated_cell(
                 violations.append(
                     Violation(v.invariant, f"[cell {rig.name}] {v.detail}")
                 )
+        # the coordinator's own breaker opening is the federation
+        # analog of a local trip: the margin signal saturates
+        if status.get("breaker"):
+            agg_signals["breaker-margin"] = 1.0
         # rng is part of the seed contract even though these scenarios
         # are deterministic by construction today
         del rng
@@ -1454,6 +1683,8 @@ def _run_federated_cell(
             "decisions": decisions_total,
             "transitions": sum(len(r.tape.transitions) for r in rigs),
             "violations": [v.to_dict() for v in violations],
+            "fitness": agg_signals,
+            "fitness_score": fitness_score(agg_signals, violations),
         }
     finally:
         for rig in rigs:
@@ -1684,6 +1915,14 @@ class Campaign:
     #: probes scheduling, which is transport-independent — crossing it
     #: with http as well would double campaign wall for no new edge.
     drivers: Tuple[str, ...] = ("polling", "event")
+    #: ratcheted regression cells (``chaos search --ratchet``): minimal
+    #: reproducer specs (scenario + axes + mutation vector + campaign
+    #: seed) appended after the matrix cells and judged by the same
+    #: checker.  The matrix only ever GROWS — a searched-out bug stays
+    #: in the sweep forever.  The default campaign (CLI/bench) attaches
+    #: the shipped file (chaossearch.load_regression_cells); an
+    #: explicit empty tuple keeps a hand-built Campaign matrix-only.
+    regression_cells: Tuple[dict, ...] = ()
 
     def cells(self) -> List[Tuple[str, str, str, str]]:
         out = []
@@ -1714,10 +1953,15 @@ def campaign_from_dict(data: dict) -> Campaign:
 
         {"name": "nightly", "seed": 7, "fleet": 12,
          "scenarios": ["apiserver-brownout", "policy-edits"],
-         "axes": {"transport": ["inmem", "http"], "gates": ["on"]}}
+         "axes": {"transport": ["inmem", "http"], "gates": ["on"]},
+         "regression_cells": [{"scenario": ..., "mutations": [...]}],
+         "regressions_file": "hack/chaos_regressions.json"}
 
     Every field is optional; omissions take the default campaign's
-    values.  Unknown scenario names fail fast."""
+    values.  Unknown scenario names fail fast.  ``regression_cells``
+    inlines ratcheted reproducer specs; ``regressions_file`` points at
+    a ratchet file (chaossearch format) — both may be given, inline
+    cells first."""
     axes = data.get("axes") or {}
     # explicit-vs-omitted matters: an operator who edits a campaign file
     # down to "scenarios": [] asked for an error, not the full catalog
@@ -1744,6 +1988,20 @@ def campaign_from_dict(data: dict) -> Campaign:
     fleet = int(data["fleet"]) if "fleet" in data else 8
     if fleet < 1:
         raise ValueError(f"campaign fleet must be >= 1, got {fleet}")
+    regressions: List[dict] = []
+    for spec in data.get("regression_cells") or ():
+        if not isinstance(spec, dict) or "scenario" not in spec:
+            raise ValueError(
+                "regression_cells entries must be dicts with a "
+                f"'scenario' key, got {spec!r}"
+            )
+        regressions.append(dict(spec))
+    if data.get("regressions_file"):
+        from . import chaossearch
+
+        regressions.extend(
+            chaossearch.load_regression_cells(data["regressions_file"])
+        )
     campaign = Campaign(
         name=str(data.get("name") or "custom"),
         seed=int(data.get("seed") or 0),
@@ -1752,6 +2010,7 @@ def campaign_from_dict(data: dict) -> Campaign:
         transports=transports,
         gates=gates,
         drivers=drivers,
+        regression_cells=tuple(regressions),
     )
     for t in campaign.transports:
         if t not in ("inmem", "http"):
@@ -1766,15 +2025,31 @@ def campaign_from_dict(data: dict) -> Campaign:
     return campaign
 
 
+def mutation_vector_key(mutations) -> str:
+    """Canonical serialization of a mutation vector (a list of plain
+    ``{"op": name, ...params}`` dicts): sorted keys, no whitespace — the
+    exact bytes that key cell_seed, so two DIFFERENT vectors can never
+    alias one seed through formatting differences."""
+    return json.dumps(list(mutations), sort_keys=True, separators=(",", ":"))
+
+
 def cell_seed(campaign_seed: int, scenario: str, transport: str, gates: str,
-              fleet_size: int, driver: str = "polling") -> int:
+              fleet_size: int, driver: str = "polling",
+              mutations=None) -> int:
     """The documented per-cell seed derivation: stable across runs and
     processes (crc32, not hash() — PYTHONHASHSEED must not matter).
-    ``polling`` (the pre-axis default) keys exactly as before, so every
-    historical cell seed is unchanged."""
+    ``polling`` (the pre-axis default) keys exactly as before, and an
+    empty mutation vector keys exactly as the pre-search format, so
+    every historical cell seed is unchanged.  A non-empty vector is
+    folded in through its canonical serialization — two mutated
+    variants of one scenario never share a seed unless they are the
+    same mutation (collision hardening; the searcher additionally
+    asserts uniqueness across each generated campaign)."""
     key = f"{campaign_seed}:{scenario}:{transport}:{gates}:{fleet_size}"
     if driver != "polling":
         key += f":{driver}"
+    if mutations:
+        key += ":" + mutation_vector_key(mutations)
     return zlib.crc32(key.encode())
 
 
@@ -2096,6 +2371,15 @@ def run_cell(
             evidence = scenario.evidence(cell) or ""
         if evidence:
             violations.append(Violation("evidence", evidence))
+        signals = fitness_signals(
+            tape=cell.audit,
+            decisions=decisions,
+            persisted_decisions=persisted,
+            store=cell.store,
+            policy=cell.policy,
+            ds_name=SimFleet.DS_NAME,
+            ds_namespace=SimFleet.NAMESPACE,
+        )
         return {
             "scenario": scenario.name,
             "transport": transport,
@@ -2111,6 +2395,8 @@ def run_cell(
             "decisions": len(decisions),
             "transitions": len(cell.audit.transitions),
             "violations": [v.to_dict() for v in violations],
+            "fitness": signals,
+            "fitness_score": fitness_score(signals, violations),
         }
     finally:
         cell.close()
@@ -2171,6 +2457,18 @@ def run_campaign(campaign: Campaign, progress=None) -> dict:
                 driver=driver,
             )
         )
+    if campaign.regression_cells:
+        # ratcheted reproducers ride after the matrix (lazy import —
+        # chaossearch imports this module at its top)
+        from . import chaossearch
+
+        for spec in campaign.regression_cells:
+            if progress is not None:
+                progress(
+                    f"regression cell {spec.get('cell') or spec['scenario']}"
+                    " ..."
+                )
+            rows.append(chaossearch.run_regression_cell(spec))
     passed = sum(1 for r in rows if r["passed"])
     return {
         "campaign": campaign.name,
@@ -2207,6 +2505,14 @@ def deterministic_scorecard(scorecard: dict) -> dict:
                 "converged": r["converged"],
                 "violations": sorted(
                     v["invariant"] for v in r["violations"]
+                ),
+                # ratcheted regression cells carry their identity (name
+                # + mutation vector) into the replay contract
+                **({"cell": r["cell"]} if r.get("cell") else {}),
+                **(
+                    {"mutations": r["mutations"]}
+                    if r.get("mutations")
+                    else {}
                 ),
             }
             for r in scorecard.get("cells") or []

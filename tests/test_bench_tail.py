@@ -85,6 +85,22 @@ def stubbed_probes(monkeypatch):
     )
     monkeypatch.setattr(
         bench,
+        "chaos_search_section",
+        lambda *a, **k: {
+            "chaos_search_generations": 9999,
+            "chaos_search_best_fitness": 99999.9999,
+            "chaos_regression_cells": 9999,
+            "chaos_search_cells": 9999,
+            "chaos_search_found": 9999,
+            "chaos_search_wall_s": 99999.99,
+            "chaos_search_findings": [
+                {"candidate": {"scenario": "y" * 24}, "fitness": 99.9}
+            ]
+            * 8,
+        },
+    )
+    monkeypatch.setattr(
+        bench,
         "race_section",
         lambda *a, **k: {
             "lockcheck_findings": 9999,
@@ -265,6 +281,13 @@ TRACKED_DETAIL_KEYS = (
     "chaos_cells_passed",
     "chaos_cells_total",
     "chaos_scenarios",
+    # coverage-guided chaos search (ISSUE 19): the standing proximity-
+    # to-violation number, the generation count behind it, and the
+    # ratchet size (monotone) — a searcher regression must be as
+    # visible per round as a resilience one
+    "chaos_search_generations",
+    "chaos_search_best_fitness",
+    "chaos_regression_cells",
     # the concurrency sanitizer (ISSUE 14): the static sweep must stay
     # finding-free and the instrumented cell cycle-free — a discipline
     # regression must be as visible per round as a speed one

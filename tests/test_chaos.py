@@ -18,7 +18,9 @@ from k8s_operator_libs_tpu.api import (
     UpgradePolicySpec,
 )
 from k8s_operator_libs_tpu.cluster import (
+    FAULT_KINDS,
     ApiServerFacade,
+    FaultSpec,
     InMemoryCluster,
     KubeApiClient,
     KubeConfig,
@@ -118,6 +120,85 @@ class TestComposableFaults:
         assert facade.fault_counters["partition_drops"] == 1
         assert facade.fault_counters["body_mutations"] >= 1
         assert store.get("Event", "e1", "default")["message"] == "skewed"
+
+    def test_faultspec_roundtrip_and_per_kind_clear(self):
+        """FaultSpec is the serializable slice of the fault stack: it
+        round-trips through plain dicts, rejects unknown fields, and
+        ``cleared(kind)`` resets exactly one kind's knobs (ISSUE 19
+        satellite — the searcher persists these in mutation vectors)."""
+        spec = FaultSpec(
+            chaos_drop_ratio=0.25,
+            chaos_seed=7,
+            request_latency_seconds=0.5,
+            latency_seed=3,
+            held_stream_max_frames=9,
+        )
+        assert FaultSpec.from_dict(spec.to_dict()) == spec
+        with pytest.raises(ValueError, match="unknown FaultSpec field"):
+            FaultSpec.from_dict({"bogus": 1})
+        for kind in FAULT_KINDS:
+            out = spec.cleared(kind)
+            assert out != spec
+            # exactly one kind reset; the original is untouched
+            diff = {
+                k
+                for k, v in out.to_dict().items()
+                if spec.to_dict()[k] != v
+            }
+            assert diff, f"cleared({kind!r}) changed nothing"
+            assert spec.chaos_drop_ratio == 0.25
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            spec.cleared("gravity")
+
+    def test_clear_fault_kind_leaves_siblings_firing_and_counting(self):
+        """The composed partial-clear seam (ISSUE 19 satellite): two
+        FaultSpecs layer chaos drops under latency across two apply
+        calls; clearing the latency KIND mid-session leaves the chaos
+        knobs armed, keeps the chaos counter climbing, and never
+        resets any tally — including the cleared kind's own."""
+        store = InMemoryCluster()
+        facade = ApiServerFacade(store)
+        FaultSpec(chaos_drop_ratio=0.4, chaos_seed=11).apply(facade)
+        FaultSpec(
+            request_latency_seconds=0.001, latency_seed=2
+        ).apply(facade)
+        cls = facade._handler_cls
+        assert cls.chaos_drop_ratio == 0.4
+        assert cls.request_latency_seconds == 0.001
+        facade.start()
+        try:
+            client = KubeApiClient(KubeConfig(server=facade.url), timeout=5.0)
+            store.create({"kind": "Node", "metadata": {"name": "n0"}})
+
+            def drive(n: int) -> None:
+                for _ in range(n):
+                    try:
+                        client.get("Node", "n0")
+                    except OSError:
+                        pass  # a chaos drop surfaced to the client
+
+            drive(20)
+            counters = facade.fault_counters
+            assert counters["chaos_drops"] >= 1
+            assert counters["delayed_requests"] >= 1
+            chaos_before = counters["chaos_drops"]
+            delayed_before = counters["delayed_requests"]
+            facade.clear_fault_kind("latency")
+            # the latency knobs are off, the sibling's untouched...
+            assert cls.request_latency_seconds == 0.0
+            assert cls.latency_rng is None
+            assert cls.chaos_drop_ratio == 0.4
+            assert cls.chaos_rng is not None
+            # ...and no counter was reset by the clear
+            assert counters["chaos_drops"] == chaos_before
+            assert counters["delayed_requests"] == delayed_before
+            drive(20)
+            # the sibling kind keeps firing AND counting; the cleared
+            # kind's tally stands as evidence but stops climbing
+            assert counters["chaos_drops"] > chaos_before
+            assert counters["delayed_requests"] == delayed_before
+        finally:
+            facade.stop()
 
 
 # ---------------------------------------------------------------- checker
